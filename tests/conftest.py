@@ -1,0 +1,68 @@
+"""Shared test fixtures.
+
+The axon sitecustomize in this image registers the TPU backend at interpreter
+startup, so JAX platform env vars cannot be changed in-process.  Tests that
+need a multi-device CPU mesh therefore run their JAX piece in a subprocess
+with a clean environment (``cpu_mesh_env``).  Pure-simulator tests (parser,
+timing, ICI, driver) need no JAX at all — by design the timing core only
+consumes the IR.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+@pytest.fixture(scope="session")
+def repo_root() -> Path:
+    return REPO_ROOT
+
+
+@pytest.fixture(scope="session")
+def fixtures_dir() -> Path:
+    return FIXTURES
+
+
+def cpu_mesh_env(n_devices: int = 8) -> dict[str, str]:
+    """Environment for a subprocess that needs an ``n_devices`` CPU mesh."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)  # drop axon site, keep tpusim
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices} "
+        + env.get("TPUSIM_EXTRA_XLA_FLAGS", "")
+    ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORM_NAME", None)
+    return env
+
+
+def run_in_cpu_mesh(code: str, n_devices: int = 8, timeout: int = 600) -> str:
+    """Run a python snippet under a virtual CPU mesh; returns stdout."""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=cpu_mesh_env(n_devices),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO_ROOT,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"subprocess failed (rc={proc.returncode}):\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr[-4000:]}"
+        )
+    return proc.stdout
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_runner():
+    return run_in_cpu_mesh

@@ -1,0 +1,169 @@
+"""Unit tests for the HLO text parser (tpusim/trace/hlo_text.py).
+
+The hand-written fixture plays the role the reference's tiny traces play for
+its parser (SURVEY.md §7 build order step 1).
+"""
+
+from pathlib import Path
+
+import pytest
+
+from tpusim.ir import TensorSpec, TupleSpec
+from tpusim.trace.hlo_text import (
+    parse_hlo_module,
+    parse_instruction,
+    parse_shape,
+    split_top_level,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+def test_split_top_level():
+    assert split_top_level("a, b, c") == ["a", "b", "c"]
+    assert split_top_level("f(a, b), {x, y}, z") == ["f(a, b)", "{x, y}", "z"]
+    assert split_top_level('a="x,y", b') == ['a="x,y"', "b"]
+    assert split_top_level("") == []
+
+
+# -- shapes ------------------------------------------------------------------
+
+def test_parse_shape_basic():
+    s = parse_shape("bf16[256,512]")
+    assert isinstance(s, TensorSpec)
+    assert s.dtype == "bf16" and s.shape == (256, 512)
+
+
+def test_parse_shape_layout_tiling_space():
+    s = parse_shape("bf16[512,1024]{1,0:T(8,128)(2,1)S(1)}")
+    assert s.layout == (1, 0)
+    assert s.tiling == "(8,128)(2,1)"
+    assert s.memory_space == 1
+
+
+def test_parse_shape_scalar_and_token():
+    s = parse_shape("f32[]{:T(256)}")
+    assert s.shape == () and s.tiling == "(256)"
+    assert parse_shape("token[]").nbytes == 0
+
+
+def test_parse_shape_tuple():
+    s = parse_shape("(bf16[128,256]{1,0}, u32[]{:T(256)})")
+    assert isinstance(s, TupleSpec)
+    assert len(s.parts) == 2
+    assert s.nbytes == 128 * 256 * 2 + 4
+
+
+# -- instructions ------------------------------------------------------------
+
+def test_parse_instruction_dot():
+    op = parse_instruction(
+        "%dot.1 = bf16[128,256]{1,0} dot(%x, %w1), "
+        "lhs_contracting_dims={1}, rhs_contracting_dims={0}, "
+        'metadata={op_name="jit(f)/dot_general" source_file="t.py" source_line=5}'
+    )
+    assert op.opcode == "dot"
+    assert op.operands == ("x", "w1")
+    assert op.attrs["lhs_contracting_dims"] == "{1}"
+    assert op.metadata["op_name"] == "jit(f)/dot_general"
+    assert not op.is_root
+
+
+def test_parse_instruction_root_and_typed_operands():
+    op = parse_instruction(
+        "ROOT %add.2 = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)"
+    )
+    assert op.is_root
+    assert op.operands == ("a", "b")
+
+
+def test_parse_instruction_constant_literal():
+    op = parse_instruction("%c = f32[]{:T(256)} constant(3.14)")
+    assert op.opcode == "constant"
+    assert op.operands == ()
+
+
+def test_parse_instruction_collective():
+    op = parse_instruction(
+        "%ar = f32[1024]{0} all-reduce(%x), channel_id=5, "
+        "replica_groups={{0,1,2,3}}, use_global_device_ids=true, "
+        "to_apply=%region_add"
+    )
+    assert op.is_collective
+    assert op.collective.kind == "all-reduce"
+    assert op.collective.replica_groups == ((0, 1, 2, 3),)
+    assert op.collective.channel_id == 5
+    assert op.collective.use_global_device_ids
+    assert "region_add" in op.called
+
+
+def test_parse_instruction_iota_replica_groups():
+    op = parse_instruction(
+        "%ag = f32[64]{0} all-gather(%x), channel_id=2, "
+        "replica_groups=[2,4]<=[8], dimensions={0}"
+    )
+    groups = op.collective.replica_groups
+    assert len(groups) == 2 and all(len(g) == 4 for g in groups)
+    assert op.collective.group_size == 4
+
+
+def test_parse_instruction_collective_permute():
+    op = parse_instruction(
+        "%cp = f32[16]{0} collective-permute(%x), channel_id=3, "
+        "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}"
+    )
+    assert op.collective.source_target_pairs == ((0, 1), (1, 2), (2, 3), (3, 0))
+    assert op.collective.group_size == 4
+
+
+def test_parse_instruction_async_pair():
+    start = parse_instruction(
+        "%ar-start = bf16[128]{0} all-reduce-start(%r), channel_id=1, "
+        "replica_groups={{0,1}}, to_apply=%region_add"
+    )
+    done = parse_instruction("%ar-done = bf16[128]{0} all-reduce-done(%ar-start)")
+    assert start.is_async_start and start.is_collective
+    assert done.is_async_done
+    assert done.operands == ("ar-start",)
+
+
+# -- full module -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_mlp():
+    return parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+
+
+def test_module_header(tiny_mlp):
+    assert tiny_mlp.name == "jit_tiny_mlp"
+    assert tiny_mlp.num_partitions == 4
+    assert tiny_mlp.num_replicas == 1
+    assert tiny_mlp.meta["is_scheduled"] is True
+
+
+def test_module_computations(tiny_mlp):
+    assert set(tiny_mlp.computations) == {"region_add", "fused_relu", "main.10"}
+    assert tiny_mlp.entry.name == "main.10"
+    assert len(tiny_mlp.entry.ops) == 8
+
+
+def test_module_fusion_links(tiny_mlp):
+    relu = tiny_mlp.entry.op("relu.1")
+    assert relu.fusion_kind == "kLoop"
+    assert relu.called == ("fused_relu",)
+    fused = tiny_mlp.computation("fused_relu")
+    assert fused.root.opcode == "maximum"
+
+
+def test_module_collective(tiny_mlp):
+    ars = tiny_mlp.entry.op("ar-start")
+    assert ars.is_collective and ars.is_async_start
+    assert ars.collective.replica_groups == ((0, 1), (2, 3))
+    assert len(tiny_mlp.collectives()) == 1  # -done is not a collective op
+
+
+def test_module_root(tiny_mlp):
+    assert tiny_mlp.entry.root.name == "dot.2"
+    assert tiny_mlp.entry.root.result.shape == (128, 64)

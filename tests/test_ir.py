@@ -1,0 +1,68 @@
+"""Unit tests for the trace IR (tpusim/ir.py)."""
+
+from tpusim.ir import (
+    CollectiveInfo,
+    CommandKind,
+    TensorSpec,
+    TraceCommand,
+    TupleSpec,
+    base_opcode,
+    dtype_bytes,
+)
+
+
+def test_dtype_bytes():
+    assert dtype_bytes("f32") == 4
+    assert dtype_bytes("bf16") == 2
+    assert dtype_bytes("s8") == 1
+    assert dtype_bytes("u4") == 0.5
+    assert dtype_bytes("pred") == 1
+
+
+def test_tensor_spec_bytes():
+    t = TensorSpec("bf16", (128, 512))
+    assert t.elems == 128 * 512
+    assert t.nbytes == 128 * 512 * 2
+    scalar = TensorSpec("f32", ())
+    assert scalar.elems == 1
+    assert scalar.nbytes == 4
+    sub_byte = TensorSpec("u4", (3,))
+    assert sub_byte.nbytes == 2  # ceil(1.5)
+
+
+def test_tuple_spec():
+    t = TupleSpec((TensorSpec("f32", (8,)), TensorSpec("u32", ())))
+    assert t.nbytes == 32 + 4
+    assert [str(x) for x in t.leaves()] == ["f32[8]", "u32[]"]
+
+
+def test_base_opcode():
+    assert base_opcode("all-reduce-start") == "all-reduce"
+    assert base_opcode("all-reduce-done") == "all-reduce"
+    assert base_opcode("copy-start") == "copy"
+    assert base_opcode("dot") == "dot"
+
+
+def test_collective_group_size():
+    c = CollectiveInfo("all-reduce", replica_groups=((0, 1), (2, 3)))
+    assert c.group_size == 2
+    p = CollectiveInfo(
+        "collective-permute", source_target_pairs=((0, 1), (1, 2), (2, 0))
+    )
+    assert p.group_size == 3
+
+
+def test_trace_command_roundtrip():
+    from tpusim.trace.format import command_from_json, command_to_json
+
+    cmd = TraceCommand(
+        kind=CommandKind.COLLECTIVE,
+        stream_id=2,
+        device_id=1,
+        nbytes=4096,
+        collective=CollectiveInfo("all-reduce", replica_groups=((0, 1),)),
+    )
+    back = command_from_json(command_to_json(cmd))
+    assert back.kind == cmd.kind
+    assert back.nbytes == 4096
+    assert back.collective.replica_groups == ((0, 1),)

@@ -1,0 +1,27 @@
+"""tpusim — a TPU-native, trace-driven, cycle-level simulator framework.
+
+A ground-up rebuild of the capabilities of Accel-Sim (distributed fork,
+reference: /root/reference) for TPU hardware:
+
+* an **XLA-HLO op tracer** that captures JAX workloads (in place of the NVBit
+  SASS tracer, ``util/tracer_nvbit/``),
+* a **timing core** that models the TPU TensorCore — MXU systolic array, VPU
+  lanes, scalar unit, vmem and HBM — (in place of the GPGPU-Sim 4.0
+  SM/cache/DRAM model under ``gpu-simulator/gpgpu-sim/src/``),
+* an **ICI torus interconnect model** with ring / bidirectional / tree
+  collective schedules (in place of the fork's constant-latency NCCL replay,
+  ``gpu-simulator/main.cc:116-134``),
+* an **AccelWattch-style power model** re-fit to TPU units
+  (``src/accelwattch/``), and
+* **orchestration / correlation harnesses** (``util/job_launching/``,
+  ``util/plotting/``).
+
+The central architectural idea carried over from the reference
+(``gpu-simulator/README.md:5-9``): the timing core consumes an
+ISA-independent IR (here: an HLO-op trace) fed by swappable frontends —
+live JAX capture or stored trace files.
+"""
+
+from tpusim.version import __version__
+
+__all__ = ["__version__"]

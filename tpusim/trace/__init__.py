@@ -1,0 +1,22 @@
+"""Stored-trace frontend: HLO text parsing + on-disk trace format.
+
+Mirror of the reference's standalone ``gpu-simulator/trace-parser/`` (it is
+dependency-free and reusable; ours likewise depends only on :mod:`tpusim.ir`).
+"""
+
+from tpusim.trace.hlo_text import parse_hlo_module, parse_shape
+from tpusim.trace.format import (
+    TraceDir,
+    load_trace,
+    save_trace,
+    parse_commandlist,
+)
+
+__all__ = [
+    "parse_hlo_module",
+    "parse_shape",
+    "TraceDir",
+    "load_trace",
+    "save_trace",
+    "parse_commandlist",
+]

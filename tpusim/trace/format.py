@@ -1,0 +1,192 @@
+"""On-disk trace format — the rebuild of the reference's trace directory
+(``kernel-N.traceg`` + ``kernelslist.g`` + ``stats.csv``, produced by
+``util/tracer_nvbit/tracer_tool/tracer_tool.cu:447-483`` and
+``traces-processing/post-traces-processing.cpp``).
+
+Layout of a trace directory::
+
+    <dir>/
+      meta.json                  capture metadata (device kind, topology, ...)
+      modules/<name>.hlo         scheduled optimized HLO text (one per module)
+      commandlist.jsonl          per-device program streams (kernelslist.g)
+
+The command list is JSONL — structured, greppable, and versioned — instead of
+the reference's positional text lines; ``nccl*`` command passthrough
+(``post-traces-processing.cpp:72-73``) becomes first-class ``collective``
+records that carry byte counts and replica groups (fixing the reference's
+recorded-nothing gap, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpusim.ir import (
+    CollectiveInfo,
+    CommandKind,
+    DeviceTrace,
+    ModuleTrace,
+    PodTrace,
+    TraceCommand,
+)
+from tpusim.trace.hlo_text import parse_hlo_module
+
+__all__ = ["TraceDir", "save_trace", "load_trace", "parse_commandlist"]
+
+TRACE_FORMAT_VERSION = 1
+
+
+@dataclass
+class TraceDir:
+    """Handle to a trace directory on disk."""
+
+    path: Path
+    meta: dict = field(default_factory=dict)
+
+    @property
+    def modules_dir(self) -> Path:
+        return self.path / "modules"
+
+    @property
+    def commandlist_path(self) -> Path:
+        return self.path / "commandlist.jsonl"
+
+    def module_names(self) -> list[str]:
+        if not self.modules_dir.is_dir():
+            return []
+        return sorted(p.stem for p in self.modules_dir.glob("*.hlo"))
+
+
+# ---------------------------------------------------------------------------
+# Command (de)serialization
+# ---------------------------------------------------------------------------
+
+
+def _collective_to_json(c: CollectiveInfo | None) -> dict | None:
+    if c is None:
+        return None
+    return {
+        "kind": c.kind,
+        "replica_groups": [list(g) for g in c.replica_groups],
+        "channel_id": c.channel_id,
+        "use_global_device_ids": c.use_global_device_ids,
+        "source_target_pairs": [list(p) for p in c.source_target_pairs],
+        "split_dimension": c.split_dimension,
+        "dimensions": list(c.dimensions),
+    }
+
+
+def _collective_from_json(d: dict | None) -> CollectiveInfo | None:
+    if d is None:
+        return None
+    return CollectiveInfo(
+        kind=d["kind"],
+        replica_groups=tuple(tuple(g) for g in d.get("replica_groups", [])),
+        channel_id=d.get("channel_id"),
+        use_global_device_ids=d.get("use_global_device_ids", False),
+        source_target_pairs=tuple(
+            (p[0], p[1]) for p in d.get("source_target_pairs", [])
+        ),
+        split_dimension=d.get("split_dimension"),
+        dimensions=tuple(d.get("dimensions", [])),
+    )
+
+
+def command_to_json(cmd: TraceCommand) -> dict:
+    return {
+        "kind": cmd.kind.value,
+        "stream": cmd.stream_id,
+        "device": cmd.device_id,
+        "bytes": cmd.nbytes,
+        "module": cmd.module,
+        "collective": _collective_to_json(cmd.collective),
+        "attrs": cmd.attrs,
+    }
+
+
+def command_from_json(d: dict) -> TraceCommand:
+    return TraceCommand(
+        kind=CommandKind(d["kind"]),
+        stream_id=d.get("stream", 0),
+        device_id=d.get("device", 0),
+        nbytes=d.get("bytes", 0),
+        module=d.get("module"),
+        collective=_collective_from_json(d.get("collective")),
+        attrs=d.get("attrs", {}),
+    )
+
+
+def parse_commandlist(path: str | Path) -> list[TraceCommand]:
+    """Parse a ``commandlist.jsonl`` — the ``parse_commandlist_file``
+    equivalent (``trace_parser.cc:220``)."""
+    cmds = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            cmds.append(command_from_json(json.loads(line)))
+    return cmds
+
+
+# ---------------------------------------------------------------------------
+# Save / load full pod traces
+# ---------------------------------------------------------------------------
+
+
+def save_trace(
+    path: str | Path,
+    modules: dict[str, str],
+    commands: list[TraceCommand],
+    meta: dict | None = None,
+) -> TraceDir:
+    """Write a trace directory.  ``modules`` maps module name → HLO text."""
+    path = Path(path)
+    (path / "modules").mkdir(parents=True, exist_ok=True)
+    meta = dict(meta or {})
+    meta.setdefault("format_version", TRACE_FORMAT_VERSION)
+    with open(path / "meta.json", "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    for name, text in modules.items():
+        safe = name.replace(os.sep, "_")
+        with open(path / "modules" / f"{safe}.hlo", "w") as f:
+            f.write(text)
+    with open(path / "commandlist.jsonl", "w") as f:
+        for cmd in commands:
+            f.write(json.dumps(command_to_json(cmd)) + "\n")
+    return TraceDir(path=path, meta=meta)
+
+
+def load_trace(path: str | Path) -> PodTrace:
+    """Load a trace directory into a :class:`PodTrace` (modules parsed)."""
+    path = Path(path)
+    meta_path = path / "meta.json"
+    meta: dict = {}
+    if meta_path.exists():
+        with open(meta_path) as f:
+            meta = json.load(f)
+
+    pod = PodTrace(meta=meta)
+    modules_dir = path / "modules"
+    if modules_dir.is_dir():
+        for mp in sorted(modules_dir.glob("*.hlo")):
+            mod = parse_hlo_module(mp.read_text(), name_hint=mp.stem)
+            # file name is the trace key; HloModule header name may differ
+            pod.modules[mp.stem] = mod
+            mod.meta.setdefault("trace_key", mp.stem)
+
+    cl = path / "commandlist.jsonl"
+    if cl.exists():
+        for cmd in parse_commandlist(cl):
+            pod.device(cmd.device_id).commands.append(cmd)
+    else:
+        # traces with modules but no explicit command stream: one launch per
+        # module on device 0, mirroring single-kernel SASS traces.
+        for name in pod.modules:
+            pod.device(0).commands.append(
+                TraceCommand(kind=CommandKind.KERNEL_LAUNCH, module=name)
+            )
+    return pod

@@ -1,0 +1,474 @@
+"""Parser for XLA optimized-HLO text → :mod:`tpusim.ir`.
+
+This is the rebuild of the reference's trace parser
+(``gpu-simulator/trace-parser/trace_parser.cc``): where that parses per-warp
+SASS instruction lines (``inst_trace_t::parse_from_string``,
+``trace_parser.cc:127``) with base+stride/base+delta address decompression,
+we parse scheduled HLO text as emitted by ``jax.jit(f).lower(...).compile()
+.as_text()`` — the format XLA itself round-trips.  HLO already *is* the right
+IR for TPU timing (SURVEY.md §7), so no binary instrumentation or address
+decompression is needed; the collective metadata the reference failed to
+record (sizes, replica groups — SURVEY.md §5) is right in the op text.
+
+The parser is pure and standalone: text in, :class:`tpusim.ir.ModuleTrace`
+out.  A fast native C++ implementation with the same contract lives in
+``native/``; this module is the reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tpusim.ir import (
+    Computation,
+    CollectiveInfo,
+    ModuleTrace,
+    TensorSpec,
+    TraceOp,
+    TupleSpec,
+)
+
+__all__ = ["parse_hlo_module", "parse_shape", "split_top_level"]
+
+
+# ---------------------------------------------------------------------------
+# Low-level tokenizing helpers
+# ---------------------------------------------------------------------------
+
+_OPENERS = {"(": ")", "{": "}", "[": "]"}
+_CLOSERS = {")": "(", "}": "{", "]": "["}
+
+
+def split_top_level(s: str, sep: str = ",") -> list[str]:
+    """Split ``s`` on ``sep`` at nesting depth 0, respecting (), {}, [] and
+    double-quoted strings."""
+    parts: list[str] = []
+    depth = 0
+    in_str = False
+    start = 0
+    i = 0
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if in_str:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c in _OPENERS:
+            depth += 1
+        elif c in _CLOSERS:
+            depth -= 1
+        elif c == sep and depth == 0:
+            parts.append(s[start:i].strip())
+            start = i + 1
+        i += 1
+    tail = s[start:].strip()
+    if tail:
+        parts.append(tail)
+    return parts
+
+
+def _find_matching(s: str, open_idx: int) -> int:
+    """Index of the closer matching the opener at ``open_idx`` (respects
+    quotes)."""
+    opener = s[open_idx]
+    closer = _OPENERS[opener]
+    depth = 0
+    in_str = False
+    i = open_idx
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if in_str:
+            if c == "\\":
+                i += 2
+                continue
+            if c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == opener:
+            depth += 1
+        elif c == closer:
+            depth -= 1
+            if depth == 0:
+                return i
+        i += 1
+    raise ValueError(f"unbalanced {opener!r} in: {s[open_idx:open_idx + 80]!r}")
+
+
+# ---------------------------------------------------------------------------
+# Shape parsing
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(
+    r"^(?P<dtype>[a-z][a-z0-9]*)"          # bf16, f32, pred, token, ...
+    r"(?:\[(?P<dims>[^\]]*)\])?"           # [256,512] ([] for scalar)
+    r"(?:\{(?P<layout>[^}]*)\})?"          # {1,0:T(8,128)(2,1)S(1)}
+    r"$"
+)
+
+_TILING_RE = re.compile(r"T(\([0-9,]*\))+")
+_SPACE_RE = re.compile(r"S\((\d+)\)")
+
+
+def parse_shape(text: str) -> TensorSpec | TupleSpec:
+    """Parse one HLO shape string, e.g. ``bf16[256,512]{1,0:T(8,128)(2,1)}``
+    or a tuple ``(f32[8]{0}, u32[])``."""
+    text = text.strip()
+    if text.startswith("("):
+        end = _find_matching(text, 0)
+        inner = text[1:end]
+        parts = tuple(parse_shape(p) for p in split_top_level(inner))
+        return TupleSpec(parts)
+    m = _SHAPE_RE.match(text)
+    if not m:
+        raise ValueError(f"unparseable HLO shape: {text!r}")
+    dtype = m.group("dtype")
+    dims_s = m.group("dims")
+    shape: tuple[int, ...] = ()
+    if dims_s:
+        dims = []
+        for d in dims_s.split(","):
+            d = d.strip().lstrip("<=")  # dynamic dims: "<=128" → bound
+            if d:
+                dims.append(int(d))
+        shape = tuple(dims)
+    layout = None
+    tiling = None
+    space = 0
+    lay_s = m.group("layout")
+    if lay_s is not None:
+        # layout text: "1,0:T(8,128)(2,1)S(1)" / "1,0" / ":T(256)"
+        minor, _, extras = lay_s.partition(":")
+        minor = minor.strip()
+        if minor:
+            layout = tuple(int(x) for x in minor.split(",") if x.strip())
+        if extras:
+            tm = _TILING_RE.search(extras)
+            if tm:
+                tiling = tm.group(0)[1:]  # drop the 'T'
+            sm = _SPACE_RE.search(extras)
+            if sm:
+                space = int(sm.group(1))
+    return TensorSpec(
+        dtype=dtype, shape=shape, layout=layout, tiling=tiling,
+        memory_space=space,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Attribute parsing
+# ---------------------------------------------------------------------------
+
+#: attr keys whose values name other computations.
+_CALLED_KEYS = (
+    "calls", "to_apply", "condition", "body", "true_computation",
+    "false_computation", "branch_computations", "called_computations",
+    "select", "scatter",
+)
+
+_REPLICA_GROUPS_IOTA_RE = re.compile(
+    r"\[(?P<dims>[0-9,]+)\]<=\[(?P<total>[0-9,]+)\]"
+)
+
+
+def _parse_replica_groups(val: str) -> tuple[tuple[int, ...], ...]:
+    """Parse ``{{0,1},{2,3}}`` or iota form ``[2,2]<=[4]`` (optionally with a
+    transpose suffix, ignored for sizing purposes beyond group structure)."""
+    val = val.strip()
+    m = _REPLICA_GROUPS_IOTA_RE.match(val)
+    if m:
+        dims = [int(x) for x in m.group("dims").split(",")]
+        total = 1
+        for x in m.group("total").split(","):
+            total *= int(x)
+        # iota groups: reshape [0..total) to dims; groups along last dim.
+        group_size = dims[-1] if dims else 1
+        n_groups = max(total // max(group_size, 1), 1)
+        it = iter(range(total))
+        return tuple(
+            tuple(next(it) for _ in range(group_size)) for _ in range(n_groups)
+        )
+    if not val.startswith("{"):
+        return ()
+    inner = val[1:-1].strip()
+    if not inner:
+        return ()
+    groups = []
+    for part in split_top_level(inner):
+        part = part.strip()
+        if part.startswith("{"):
+            part = part[1:-1]
+        nums = tuple(int(x) for x in part.split(",") if x.strip())
+        groups.append(nums)
+    return tuple(groups)
+
+
+def _parse_int_set(val: str) -> tuple[int, ...]:
+    val = val.strip().strip("{}")
+    return tuple(int(x) for x in val.split(",") if x.strip())
+
+
+def _parse_pairs(val: str) -> tuple[tuple[int, int], ...]:
+    """Parse ``{{0,1},{1,2}}`` into pairs."""
+    val = val.strip()
+    if val.startswith("{"):
+        val = val[1:-1]
+    pairs = []
+    for part in split_top_level(val):
+        part = part.strip()
+        if not part:
+            continue
+        nums = _parse_int_set(part)
+        if len(nums) == 2:
+            pairs.append((nums[0], nums[1]))
+    return tuple(pairs)
+
+
+def _collect_called(attrs: dict[str, str]) -> tuple[str, ...]:
+    called: list[str] = []
+    for key in _CALLED_KEYS:
+        if key not in attrs:
+            continue
+        val = attrs[key].strip()
+        if val.startswith("{"):
+            val = val[1:-1]
+        for tok in split_top_level(val):
+            tok = tok.strip()
+            if tok.startswith("%"):
+                called.append(tok[1:])
+            elif tok:
+                called.append(tok)
+    return tuple(called)
+
+
+def _maybe_collective(opcode_base: str, attrs: dict[str, str]) -> CollectiveInfo | None:
+    from tpusim.ir import COLLECTIVE_OPCODES
+
+    if opcode_base not in COLLECTIVE_OPCODES:
+        return None
+    rg = ()
+    if "replica_groups" in attrs:
+        rg = _parse_replica_groups(attrs["replica_groups"])
+    channel = None
+    if "channel_id" in attrs:
+        try:
+            channel = int(attrs["channel_id"])
+        except ValueError:
+            pass
+    pairs = ()
+    if "source_target_pairs" in attrs:
+        pairs = _parse_pairs(attrs["source_target_pairs"])
+    dims = ()
+    if "dimensions" in attrs:
+        dims = _parse_int_set(attrs["dimensions"])
+    split_dim = None
+    for k in ("split_dimension", "dimension"):
+        if k in attrs:
+            try:
+                split_dim = int(attrs[k])
+            except ValueError:
+                pass
+            break
+    return CollectiveInfo(
+        kind=opcode_base,
+        replica_groups=rg,
+        channel_id=channel,
+        use_global_device_ids=attrs.get("use_global_device_ids", "") == "true",
+        source_target_pairs=pairs,
+        split_dimension=split_dim,
+        dimensions=dims,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Instruction-line parsing
+# ---------------------------------------------------------------------------
+
+_INSTR_RE = re.compile(
+    r"^(?P<root>ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$"
+)
+
+_METADATA_FIELD_RE = re.compile(r'(\w+)=(?:"((?:[^"\\]|\\.)*)"|(\S+))')
+
+
+def _parse_metadata(val: str) -> dict[str, str]:
+    val = val.strip()
+    if val.startswith("{"):
+        val = val[1:-1]
+    out = {}
+    for m in _METADATA_FIELD_RE.finditer(val):
+        out[m.group(1)] = m.group(2) if m.group(2) is not None else m.group(3)
+    return out
+
+
+def _parse_operands(operand_str: str) -> tuple[str, ...]:
+    """Extract operand value names from the call parens.  Tolerates both
+    typed (``f32[2]{0} %a``) and untyped (``%a``) operand syntax; skips
+    literals (constants) which carry no ``%``."""
+    names = []
+    for part in split_top_level(operand_str):
+        part = part.strip()
+        if not part:
+            continue
+        # the operand name is the last %-token in the fragment
+        idx = part.rfind("%")
+        if idx >= 0:
+            tok = part[idx + 1:]
+            tok = tok.split()[0] if tok.split() else ""
+            names.append(tok.rstrip(","))
+    return tuple(names)
+
+
+def parse_instruction(line: str) -> TraceOp | None:
+    """Parse one instruction line of a computation body.  Returns None for
+    non-instruction lines (blank, comments, closing braces)."""
+    line = line.strip()
+    if not line or line in ("}", "{") or line.startswith("//"):
+        return None
+    m = _INSTR_RE.match(line)
+    if not m:
+        return None
+    rest = m.group("rest").strip()
+
+    # result shape: either a tuple "(...)" or "dtype[...]{...}"
+    if rest.startswith("("):
+        end = _find_matching(rest, 0)
+        shape_text = rest[: end + 1]
+        rest = rest[end + 1:].strip()
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return None
+        shape_text = rest[:sp]
+        rest = rest[sp + 1:].strip()
+    result = parse_shape(shape_text)
+
+    # opcode and its argument parens
+    paren = rest.find("(")
+    if paren < 0:
+        return None
+    opcode = rest[:paren].strip()
+    close = _find_matching(rest, paren)
+    operand_str = rest[paren + 1: close]
+    attr_str = rest[close + 1:].lstrip(", ")
+
+    operands = _parse_operands(operand_str)
+
+    attrs: dict[str, str] = {}
+    metadata: dict[str, str] = {}
+    if attr_str:
+        for tok in split_top_level(attr_str):
+            if not tok:
+                continue
+            key, eq, val = tok.partition("=")
+            key = key.strip()
+            if not eq:
+                attrs[key] = ""
+                continue
+            val = val.strip()
+            if key == "metadata":
+                metadata = _parse_metadata(val)
+            else:
+                attrs[key] = val
+
+    from tpusim.ir import base_opcode
+
+    op = TraceOp(
+        name=m.group("name"),
+        opcode=opcode,
+        result=result,
+        operands=operands,
+        called=_collect_called(attrs),
+        fusion_kind=attrs.get("kind"),
+        collective=_maybe_collective(base_opcode(opcode), attrs),
+        attrs=attrs,
+        metadata=metadata,
+        is_root=bool(m.group("root")),
+    )
+    return op
+
+
+# ---------------------------------------------------------------------------
+# Module-level parsing
+# ---------------------------------------------------------------------------
+
+_COMP_HEADER_RE = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*"
+    r"\((?P<params>.*)\)\s*->\s*(?P<ret>.+?)\s*\{\s*$"
+)
+
+_MODULE_RE = re.compile(r"^HloModule\s+(?P<name>[\w.\-]+)\s*(?:,\s*(?P<attrs>.*))?$")
+
+_MODULE_INT_ATTRS = ("replica_count", "num_partitions")
+
+
+def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
+    """Parse a full HLO module text dump into a :class:`ModuleTrace`.
+
+    Accepts the output of ``compiled.as_text()`` (scheduled, optimized TPU
+    HLO with layouts) as well as unoptimized ``lowered.as_text()`` dumps and
+    hand-written fixtures.  Trailing sections (e.g. the ``FileLocations`` /
+    ``StackFrames`` tables emitted by newer XLA) are ignored.
+    """
+    module = ModuleTrace(name=name_hint)
+    current: Computation | None = None
+    in_tail_tables = False
+
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        stripped = line.strip()
+        if not stripped:
+            continue
+
+        mm = _MODULE_RE.match(stripped)
+        if mm and current is None:
+            module.name = mm.group("name")
+            attr_text = mm.group("attrs") or ""
+            for tok in split_top_level(attr_text):
+                key, eq, val = tok.partition("=")
+                if not eq:
+                    continue
+                key, val = key.strip(), val.strip()
+                if key in _MODULE_INT_ATTRS:
+                    try:
+                        module.meta[key] = int(val)
+                    except ValueError:
+                        pass
+                elif key == "is_scheduled":
+                    module.meta[key] = val == "true"
+            continue
+
+        # Tail tables from newer XLA dumps ("FileNames", "FileLocations", ...)
+        if current is None and stripped in (
+            "FileNames", "FunctionNames", "FileLocations", "StackFrames",
+        ):
+            in_tail_tables = True
+            continue
+        if in_tail_tables and current is None:
+            continue
+
+        ch = _COMP_HEADER_RE.match(stripped)
+        if ch and current is None:
+            current = Computation(
+                name=ch.group("name"), is_entry=bool(ch.group("entry"))
+            )
+            continue
+
+        if current is not None:
+            if stripped == "}":
+                module.add_computation(current)
+                current = None
+                continue
+            op = parse_instruction(stripped)
+            if op is not None:
+                current.add(op)
+
+    if current is not None:  # unterminated last computation (tolerate)
+        module.add_computation(current)
+    return module
