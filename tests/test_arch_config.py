@@ -1,0 +1,81 @@
+"""Tests for the config system + arch presets."""
+
+import pytest
+
+from tpusim.timing import ARCH_PRESETS, arch_preset
+from tpusim.timing.arch import detect_arch
+from tpusim.timing.config import (
+    ArchConfig,
+    SimConfig,
+    load_config,
+    overlay,
+    parse_flag_file,
+)
+
+
+def test_presets_match_published_peaks():
+    # derived bf16 peak = 2 * mxus * rows * cols * clock
+    expect = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    for name, peak in expect.items():
+        arch = arch_preset(name)
+        assert arch.peak_bf16_flops == pytest.approx(peak, rel=0.02), name
+
+
+def test_preset_unknown():
+    with pytest.raises(KeyError):
+        arch_preset("v99")
+
+
+def test_detect_arch():
+    assert detect_arch("TPU v5 lite").name == "v5e"
+    assert detect_arch("TPU v5p").name == "v5p"
+    assert detect_arch("TPU v4").name == "v4"
+    assert detect_arch("weird accelerator").name == "v5e"  # fallback
+
+
+def test_overlay_nested():
+    cfg = SimConfig()
+    out = overlay(cfg, {"arch": {"clock_ghz": 2.0, "ici": {"link_bandwidth": 1e9}}})
+    assert out.arch.clock_ghz == 2.0
+    assert out.arch.ici.link_bandwidth == 1e9
+    # original untouched (frozen dataclasses)
+    assert cfg.arch.clock_ghz != 2.0
+
+
+def test_overlay_unknown_key():
+    with pytest.raises(KeyError):
+        overlay(SimConfig(), {"nonexistent_knob": 1})
+
+
+def test_flag_file(tmp_path):
+    p = tmp_path / "sim.config"
+    p.write_text(
+        "# comment\n"
+        "-kernel_window 16\n"
+        "-arch.mxu_count 4\n"
+        "-arch.ici.link_bandwidth 4.5e10\n"
+        "-overlap_collectives false\n"
+    )
+    updates = parse_flag_file(p)
+    cfg = overlay(SimConfig(), updates)
+    assert cfg.kernel_window == 16
+    assert cfg.arch.mxu_count == 4
+    assert cfg.arch.ici.link_bandwidth == 4.5e10
+    assert cfg.overlap_collectives is False
+
+
+def test_load_config_composition(tmp_path):
+    p = tmp_path / "over.config"
+    p.write_text("-arch.clock_ghz 1.0\n")
+    cfg = load_config(arch="v5e", overlays=[p, {"kernel_window": 2}])
+    assert cfg.arch.name == "v5e"
+    assert cfg.arch.clock_ghz == 1.0
+    assert cfg.kernel_window == 2
+
+
+def test_derived_quantities():
+    a = ArchConfig()
+    assert a.hbm_bytes_per_cycle == pytest.approx(a.hbm_bandwidth / a.clock_hz)
+    assert a.seconds_to_cycles(1.0) == a.clock_hz
+    assert a.mxu_dtype_mult("bf16") == 1.0
+    assert a.mxu_dtype_mult("s8") == 2.0

@@ -1,0 +1,181 @@
+"""Tests for the cost model + timing engine on the hand-written fixture."""
+
+from pathlib import Path
+
+import pytest
+
+from tpusim.ir import Unit
+from tpusim.timing.config import SimConfig, overlay
+from tpusim.timing.cost import CostModel, dot_dims, while_trip_count
+from tpusim.timing.engine import Engine
+from tpusim.trace.hlo_text import parse_hlo_module, parse_instruction
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="module")
+def tiny_mlp():
+    return parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+
+
+@pytest.fixture(scope="module")
+def v5p_cfg():
+    return SimConfig()  # default arch is v5p
+
+
+# -- dot dims ---------------------------------------------------------------
+
+def test_dot_dims(tiny_mlp):
+    entry = tiny_mlp.entry
+    b, m, n, k, dt = dot_dims(entry.op("dot.1"), entry)
+    assert (b, m, n, k) == (1, 128, 256, 512)
+    assert dt == "bf16"
+
+
+def test_mxu_cycles_big_matmul_near_peak(v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    m = n = k = 4096
+    cycles = cm.mxu_cycles(1, m, n, k, "bf16")
+    ideal = 2.0 * m * n * k / v5p_cfg.arch.mxu_flops_per_cycle
+    # fill/drain overhead keeps us within ~5% of ideal for big shapes
+    assert ideal <= cycles <= ideal * 1.1
+
+
+def test_mxu_cycles_small_matmul_inefficient(v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    cycles = cm.mxu_cycles(1, 8, 8, 8, "bf16")
+    ideal = 2.0 * 8 * 8 * 8 / v5p_cfg.arch.mxu_flops_per_cycle
+    assert cycles > 50 * ideal  # tiny matmuls waste the systolic array
+
+
+def test_int8_faster_than_bf16(v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    assert cm.mxu_cycles(1, 1024, 1024, 1024, "s8") < cm.mxu_cycles(
+        1, 1024, 1024, 1024, "bf16"
+    )
+
+
+def test_while_trip_count():
+    op = parse_instruction(
+        "%w = f32[8]{0} while(%init), condition=%cond, body=%body, "
+        'backend_config={"known_trip_count":{"n":"12"}}'
+    )
+    assert while_trip_count(op) == 12
+    op2 = parse_instruction(
+        "%w2 = f32[8]{0} while(%init), condition=%cond, body=%body"
+    )
+    assert while_trip_count(op2, default=3) == 3
+
+
+# -- op costs ---------------------------------------------------------------
+
+def test_dot_cost_compute_bound(tiny_mlp, v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    entry = tiny_mlp.entry
+    c = cm.op_cost(entry.op("dot.1"), entry, tiny_mlp)
+    assert c.unit == Unit.MXU
+    assert c.flops == 2 * 128 * 256 * 512
+    assert c.cycles > 0
+    assert c.hbm_bytes == (128 * 512 + 512 * 256 + 128 * 256) * 2
+
+
+def test_fusion_cost_aggregates_inner(tiny_mlp, v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    entry = tiny_mlp.entry
+    c = cm.op_cost(entry.op("relu.1"), entry, tiny_mlp)
+    assert c.unit == Unit.VPU
+    # fused max + broadcast over 128x256 elements
+    assert c.flops >= 128 * 256
+    # memory-bound: reads + writes 128x256 bf16
+    assert c.hbm_bytes == 2 * 128 * 256 * 2
+
+
+def test_free_ops_cost_nothing(tiny_mlp, v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    entry = tiny_mlp.entry
+    c = cm.op_cost(entry.op("x"), entry, tiny_mlp)
+    assert c.cycles == 0
+
+
+def test_collective_payload(tiny_mlp, v5p_cfg):
+    cm = CostModel(v5p_cfg.arch)
+    entry = tiny_mlp.entry
+    c = cm.op_cost(entry.op("ar-start"), entry, tiny_mlp)
+    assert c.unit == Unit.ICI
+    assert c.ici_bytes == 128 * 256 * 2
+
+
+# -- engine -----------------------------------------------------------------
+
+def test_engine_runs_fixture(tiny_mlp, v5p_cfg):
+    res = Engine(v5p_cfg).run(tiny_mlp)
+    assert res.cycles > 0
+    assert res.seconds == pytest.approx(
+        res.cycles / v5p_cfg.arch.clock_hz
+    )
+    assert res.collective_count == 1
+    assert res.ici_bytes == 128 * 256 * 2
+    # both dots' flops accounted
+    assert res.mxu_flops == 2 * 128 * 256 * 512 + 2 * 128 * 64 * 256
+
+
+def test_engine_overlap_beats_serial(tiny_mlp):
+    ov = Engine(SimConfig(overlap_collectives=True)).run(tiny_mlp)
+    ser = Engine(
+        SimConfig(overlap_collectives=False)
+    ).run(tiny_mlp)
+    # fixture has compute after the all-reduce-done, so overlap gain is
+    # bounded, but serial must never be faster
+    assert ser.cycles >= ov.cycles
+    assert ser.exposed_collective_cycles >= ov.exposed_collective_cycles
+
+
+def test_engine_timeline(tiny_mlp, v5p_cfg):
+    eng = Engine(v5p_cfg, record_timeline=True)
+    res = eng.run(tiny_mlp)
+    names = [e.name for e in res.timeline]
+    assert "dot.1" in names and "ar-start" in names
+    for e in res.timeline:
+        assert e.end_cycle >= e.start_cycle >= 0
+
+
+def test_engine_while_loop(v5p_cfg):
+    text = """
+HloModule loop_test, is_scheduled=true
+
+%body (p: f32[1024,1024]) -> f32[1024,1024] {
+  %p = f32[1024,1024]{1,0} parameter(0)
+  ROOT %dotb = f32[1024,1024]{1,0} dot(%p, %p), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+%cond (p2: f32[1024,1024]) -> pred[] {
+  %p2 = f32[1024,1024]{1,0} parameter(0)
+  ROOT %lt = pred[] constant(true)
+}
+
+ENTRY %main (x: f32[1024,1024]) -> f32[1024,1024] {
+  %x = f32[1024,1024]{1,0} parameter(0)
+  ROOT %w = f32[1024,1024]{1,0} while(%x), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"10"}}
+}
+"""
+    mod = parse_hlo_module(text)
+    res = Engine(v5p_cfg).run(mod)
+    single = """
+HloModule one, is_scheduled=true
+
+ENTRY %main (x: f32[1024,1024]) -> f32[1024,1024] {
+  %x = f32[1024,1024]{1,0} parameter(0)
+  ROOT %d = f32[1024,1024]{1,0} dot(%x, %x), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+    one = Engine(v5p_cfg).run(parse_hlo_module(single))
+    assert res.cycles == pytest.approx(10 * one.cycles, rel=0.15)
+    assert res.flops == pytest.approx(10 * one.flops, rel=1e-6)
+
+
+def test_stats_dict(tiny_mlp, v5p_cfg):
+    res = Engine(v5p_cfg).run(tiny_mlp)
+    d = res.stats_dict()
+    assert d["sim_cycles"] == res.cycles
+    assert d["collective_count"] == 1
+    assert "busy_cycles_mxu" in d

@@ -1,0 +1,122 @@
+"""Tests for the ICI topology + collective schedules (vs analytic formulas)."""
+
+import pytest
+
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.topology import Topology, torus_for
+from tpusim.ir import CollectiveInfo
+from tpusim.timing.config import IciConfig
+
+MB = 1024 * 1024
+
+
+# -- topology ---------------------------------------------------------------
+
+def test_torus_factorization():
+    t = torus_for(64, "v5p")
+    assert t.num_chips == 64
+    assert t.dims == (4, 4, 4)
+    assert all(t.wrap)
+
+    t2 = torus_for(16, "v5e")
+    assert t2.num_chips == 16
+    assert t2.dims == (4, 4)
+
+    t1 = torus_for(1)
+    assert t1.num_chips == 1
+
+
+def test_coords_roundtrip():
+    t = torus_for(64, "v5p")
+    for chip in (0, 1, 17, 63):
+        assert t.chip_at(t.coords(chip)) == chip
+
+
+def test_hop_distance_wraparound():
+    t = Topology(dims=(8,), wrap=(True,))
+    assert t.hop_distance(0, 1) == 1
+    assert t.hop_distance(0, 7) == 1  # wrap link
+    assert t.hop_distance(0, 4) == 4
+    m = Topology(dims=(8,), wrap=(False,))
+    assert m.hop_distance(0, 7) == 7  # no wrap
+
+
+# -- collectives ------------------------------------------------------------
+
+@pytest.fixture
+def model8():
+    topo = Topology(dims=(8,), wrap=(True,))
+    cfg = IciConfig(
+        link_bandwidth=100e9, efficiency=1.0, hop_latency=1e-6,
+        launch_latency=0.0,
+    )
+    return CollectiveModel(topo, cfg)
+
+
+def test_allreduce_matches_ring_formula(model8):
+    n, payload = 8, 256 * MB
+    t = model8.allreduce_seconds(payload, n)
+    # 1 axis -> 2 directions; ring term 2(N-1)/N * B / (W*2)
+    ring = 2 * (n - 1) / n * payload / (100e9 * 2) + 2 * (n - 1) * 1e-6
+    tree = 2 * payload / (100e9 * 2) + 2 * 3 * 1e-6
+    assert t == pytest.approx(min(ring, tree), rel=1e-9)
+
+
+def test_allreduce_large_payload_scales_linearly(model8):
+    t1 = model8.allreduce_seconds(64 * MB, 8)
+    t2 = model8.allreduce_seconds(128 * MB, 8)
+    assert t2 / t1 == pytest.approx(2.0, rel=0.05)
+
+
+def test_allgather_cheaper_than_allreduce(model8):
+    b = 128 * MB
+    assert model8.allgather_seconds(b, 8) < model8.allreduce_seconds(b, 8)
+
+
+def test_multi_axis_speedup():
+    cfg = IciConfig(link_bandwidth=100e9, efficiency=1.0,
+                    hop_latency=0.0, launch_latency=0.0)
+    one_axis = CollectiveModel(Topology((64,), (True,)), cfg)
+    three_axis = CollectiveModel(Topology((4, 4, 4), (True,) * 3), cfg)
+    b = 1024 * MB
+    # 3 torus axes = 3x the usable link directions
+    assert three_axis.allreduce_seconds(b, 64) < one_axis.allreduce_seconds(b, 64)
+
+
+def test_permute_neighbor_shift(model8):
+    pairs = tuple((i, (i + 1) % 8) for i in range(8))
+    t = model8.permute_seconds(64 * MB, pairs)
+    # each chip sends one payload over one hop
+    assert t == pytest.approx(64 * MB / 100e9 + 1e-6, rel=1e-6)
+
+
+def test_small_message_latency_dominated(model8):
+    t = model8.allreduce_seconds(64, 8)  # 64 bytes
+    # tree: 2*log2(8) hops of 1us dominates
+    assert t == pytest.approx(6e-6, rel=0.2)
+
+
+def test_dcn_spanning_group():
+    cfg = IciConfig(
+        link_bandwidth=100e9, efficiency=1.0, hop_latency=1e-6,
+        launch_latency=0.0, chips_per_slice=8, dcn_bandwidth=10e9,
+    )
+    m = CollectiveModel(Topology((16,), (True,)), cfg)
+    intra = CollectiveModel(
+        Topology((16,), (True,)),
+        IciConfig(link_bandwidth=100e9, efficiency=1.0, hop_latency=1e-6,
+                  launch_latency=0.0),
+    )
+    b = 256 * MB
+    assert m.allreduce_seconds(b, 16) > intra.allreduce_seconds(b, 16)
+
+
+def test_dispatch_kinds(model8):
+    b = 8 * MB
+    for kind in ("all-reduce", "all-gather", "reduce-scatter", "all-to-all"):
+        info = CollectiveInfo(kind, replica_groups=(tuple(range(8)),))
+        assert model8.seconds(info, b) > 0
+    cp = CollectiveInfo(
+        "collective-permute", source_target_pairs=((0, 1), (1, 0))
+    )
+    assert model8.seconds(cp, b) > 0

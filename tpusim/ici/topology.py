@@ -1,0 +1,119 @@
+"""ICI topologies: tori of 1-3 dimensions.
+
+Models the physical chip meshes TPU pods are built from: v4/v5p slices are 3D
+tori (wrap-around links on axes of length >= some threshold; smaller slices
+are meshes), v5e/v6e slices are 2D tori up to 16x16.  This replaces the
+reference's BookSim topology zoo (``src/intersim2/networks/``) with the two
+shapes TPUs actually use, while keeping the narrow-interface idea of
+``icnt_wrapper.h:36-64`` — the collective model only asks a topology for
+axis lengths, wrap-ness, and hop distances.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+__all__ = ["Topology", "torus_for"]
+
+
+@dataclass(frozen=True)
+class Topology:
+    """An N-dimensional (1..3) torus/mesh of chips."""
+
+    dims: tuple[int, ...]            # e.g. (4, 4, 4) for v5p-128 (64 chips)
+    wrap: tuple[bool, ...]           # per-axis wraparound links present?
+
+    def __post_init__(self):
+        if len(self.dims) != len(self.wrap):
+            raise ValueError("dims and wrap must have equal length")
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def ndims(self) -> int:
+        return len(self.dims)
+
+    def coords(self, chip: int) -> tuple[int, ...]:
+        out = []
+        for d in self.dims:
+            out.append(chip % d)
+            chip //= d
+        return tuple(out)
+
+    def chip_at(self, coords: tuple[int, ...]) -> int:
+        idx = 0
+        stride = 1
+        for c, d in zip(coords, self.dims):
+            idx += (c % d) * stride
+            stride *= d
+        return idx
+
+    def hop_distance(self, a: int, b: int) -> int:
+        """Shortest-path hops between two chips."""
+        ca, cb = self.coords(a), self.coords(b)
+        total = 0
+        for x, y, d, w in zip(ca, cb, self.dims, self.wrap):
+            delta = abs(x - y)
+            total += min(delta, d - delta) if w else delta
+        return total
+
+    def axis_ring_length(self, axis: int) -> int:
+        return self.dims[axis]
+
+    def axis_is_ring(self, axis: int) -> bool:
+        """True if the axis supports a wraparound ring (torus links)."""
+        return self.wrap[axis] and self.dims[axis] >= 2
+
+    @property
+    def links_per_chip(self) -> int:
+        """Usable ICI links per chip (2 per axis on a torus axis, fewer on
+        mesh edges — reported as the interior count)."""
+        return sum(2 if d > 1 else 0 for d in self.dims)
+
+    def bisection_links(self) -> int:
+        """Links crossing a bisection of the longest axis (for all-to-all)."""
+        if self.num_chips <= 1:
+            return 1
+        longest = max(range(self.ndims), key=lambda i: self.dims[i])
+        other = self.num_chips // self.dims[longest]
+        per_cut = other * (2 if self.wrap[longest] else 1)
+        return max(per_cut, 1)
+
+
+def torus_for(num_chips: int, generation: str = "v5p") -> Topology:
+    """Build the default slice topology for ``num_chips`` of a generation.
+
+    v4/v5p: 3D torus (cube-ish factorization; axes of length >= 4 get wrap
+    links, matching how full cube slices are wired).  v5e/v6e: 2D torus up
+    to 16x16.  Single chip: trivial topology.
+    """
+    if num_chips <= 1:
+        return Topology(dims=(1,), wrap=(False,))
+    gen = generation.lower()
+    if gen in ("v5e", "v6e"):
+        dims2 = _factor(num_chips, 2)
+        wrap2 = tuple(d >= 4 for d in dims2)
+        return Topology(dims=dims2, wrap=wrap2)
+    dims3 = _factor(num_chips, 3)
+    wrap3 = tuple(d >= 4 for d in dims3)
+    return Topology(dims=dims3, wrap=wrap3)
+
+
+def _factor(n: int, ndims: int) -> tuple[int, ...]:
+    """Factor ``n`` into ``ndims`` near-equal factors (largest last)."""
+    dims = [1] * ndims
+    remaining = n
+    for i in range(ndims - 1):
+        target = round(remaining ** (1.0 / (ndims - i)))
+        f = 1
+        for cand in range(target, 0, -1):
+            if remaining % cand == 0:
+                f = cand
+                break
+        dims[i] = f
+        remaining //= f
+    dims[-1] = remaining
+    return tuple(sorted(dims))

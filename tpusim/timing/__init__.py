@@ -1,0 +1,26 @@
+"""Cycle-level TPU TensorCore timing model.
+
+The rebuild of the reference's performance core
+(``gpu-simulator/gpgpu-sim/src/gpgpu-sim/``: ``gpu-sim.cc`` clock domains,
+``shader.cc`` SM pipeline, ``dram.cc``/``gpu-cache.cc`` memory system) at the
+granularity that matches how a TPU actually executes: one scheduled HLO op at
+a time on a TensorCore, with async DMA and ICI transfers overlapping compute.
+"""
+
+from tpusim.timing.config import ArchConfig, SimConfig, load_config, parse_flag_file
+from tpusim.timing.arch import ARCH_PRESETS, arch_preset
+from tpusim.timing.cost import CostModel, OpCost
+from tpusim.timing.engine import Engine, EngineResult
+
+__all__ = [
+    "ArchConfig",
+    "SimConfig",
+    "load_config",
+    "parse_flag_file",
+    "ARCH_PRESETS",
+    "arch_preset",
+    "CostModel",
+    "OpCost",
+    "Engine",
+    "EngineResult",
+]

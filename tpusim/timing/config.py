@@ -1,0 +1,254 @@
+"""Typed configuration system.
+
+The rebuild of the reference's option registry (``src/option_parser.{h,cc}``,
+used ~300× via ``option_parser_register``) and its config-composition scheme
+(base ``gpgpusim.config`` + per-benchmark overlays + ``extra_params``
+concatenation, ``util/job_launching/run_simulations.py:303-328``).
+
+Design changes, per SURVEY.md §7: configs are **typed dataclasses** instead of
+a stringly-typed flag soup, but the composability is preserved — a named arch
+preset, overlaid with dicts, JSON files, or reference-style ``-flag value``
+flag files (so run dirs can still concatenate overlays the way
+``append_gpgpusim_config`` does).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields
+from pathlib import Path
+from typing import Any
+
+__all__ = [
+    "ArchConfig",
+    "IciConfig",
+    "SimConfig",
+    "load_config",
+    "parse_flag_file",
+    "overlay",
+]
+
+
+@dataclass(frozen=True)
+class IciConfig:
+    """Inter-chip interconnect parameters (the ``icnt`` config equivalent —
+    reference: ``-network_mode`` + intersim config, ``icnt_wrapper.h:36-64``).
+    """
+
+    topology: str = "torus3d"          # torus3d | torus2d | mesh2d | ring
+    # per-link, per-direction bandwidth in bytes/second
+    link_bandwidth: float = 90e9
+    # serialization latency per hop (seconds): SerDes + router
+    hop_latency: float = 1e-6
+    # software/launch latency per collective (seconds)
+    launch_latency: float = 2e-6
+    # links per chip per torus axis direction (1 = single link each way)
+    links_per_axis: int = 1
+    # fraction of peak link bandwidth achievable (protocol efficiency)
+    efficiency: float = 0.85
+    # DCN (multi-slice) parameters, used when a group spans slices
+    dcn_bandwidth: float = 25e9
+    dcn_latency: float = 10e-6
+    chips_per_slice: int = 0            # 0 = single slice
+
+
+@dataclass(frozen=True)
+class ArchConfig:
+    """One TPU generation's TensorCore + memory + ICI parameters.
+
+    The analogue of a ``gpgpusim.config`` machine section
+    (``configs/tested-cfgs/SM7_QV100/gpgpusim.config:64-166``: SM count,
+    clocks, mem controllers) plus the ``trace.config`` latency tables.
+    """
+
+    name: str = "v5p"
+    # --- clocks -----------------------------------------------------------
+    clock_ghz: float = 1.75
+
+    # --- MXU (systolic array) --------------------------------------------
+    mxu_count: int = 8
+    mxu_rows: int = 128
+    mxu_cols: int = 128
+    # pipeline fill/drain latency (cycles) per matmul pass
+    mxu_fill_cycles: int = 128
+    # dtype multiplier: relative MAC throughput vs bf16
+    dtype_mult: dict[str, float] = field(
+        default_factory=lambda: {
+            "bf16": 1.0, "f16": 1.0,
+            "f32": 0.25,           # fp32 via multi-pass on the MXU
+            "f64": 0.05,
+            "s8": 2.0, "u8": 2.0, "s4": 4.0, "u4": 4.0,
+            "f8e4m3": 2.0, "f8e5m2": 2.0, "f8e4m3fn": 2.0,
+            "s32": 0.25, "u32": 0.25,
+        }
+    )
+
+    # --- VPU --------------------------------------------------------------
+    vpu_sublanes: int = 8
+    vpu_lanes: int = 128
+    vpu_alus: int = 4                  # parallel ALU ops per lane per cycle
+    # transcendental ops (exp/log/tanh/...) per cycle across the VPU
+    vpu_transcendental_per_cycle: int = 512
+
+    # --- scalar / control -------------------------------------------------
+    scalar_op_cycles: int = 1
+    # fixed per-HLO-op dispatch overhead in cycles (sequencer + DMA setup)
+    op_overhead_cycles: int = 35
+
+    # --- memory -----------------------------------------------------------
+    hbm_bandwidth: float = 2765e9      # bytes/sec
+    hbm_latency: float = 700e-9        # seconds, first-byte
+    hbm_gib: float = 95.7
+    vmem_bytes: int = 128 * 1024 * 1024
+    vmem_bandwidth_mult: float = 10.0  # vmem bw as multiple of HBM bw
+    # host <-> HBM (PCIe/DMA) for infeed/outfeed & memcpy modeling
+    host_bandwidth: float = 32e9
+    host_latency: float = 5e-6
+
+    # --- ICI --------------------------------------------------------------
+    ici: IciConfig = field(default_factory=IciConfig)
+
+    # --- derived ----------------------------------------------------------
+    @property
+    def clock_hz(self) -> float:
+        return self.clock_ghz * 1e9
+
+    @property
+    def mxu_flops_per_cycle(self) -> float:
+        """Peak bf16 FLOPs per cycle across all MXUs (2 flops per MAC)."""
+        return 2.0 * self.mxu_count * self.mxu_rows * self.mxu_cols
+
+    @property
+    def peak_bf16_flops(self) -> float:
+        return self.mxu_flops_per_cycle * self.clock_hz
+
+    @property
+    def vpu_flops_per_cycle(self) -> float:
+        return float(self.vpu_sublanes * self.vpu_lanes * self.vpu_alus)
+
+    @property
+    def hbm_bytes_per_cycle(self) -> float:
+        return self.hbm_bandwidth / self.clock_hz
+
+    def seconds_to_cycles(self, s: float) -> float:
+        return s * self.clock_hz
+
+    def cycles_to_seconds(self, c: float) -> float:
+        return c / self.clock_hz
+
+    def mxu_dtype_mult(self, dtype: str) -> float:
+        return self.dtype_mult.get(dtype, 0.25)
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Simulation-run knobs (the driver/behavioral flags of ``gpu-sim.h``:
+    stream windowing ``main.cc:74-115``, deadlock detect, stat sampling)."""
+
+    arch: ArchConfig = field(default_factory=ArchConfig)
+    # max kernels in flight across streams (reference: window of concurrent
+    # kernels, main.cc:74)
+    kernel_window: int = 8
+    # model memcpy time (reference: -gpgpu_perf_sim_memcpy)
+    perf_sim_memcpy: bool = True
+    # model compute/collective overlap (False = serial like the fork's
+    # -nccl_allreduce_latency add at main.cc:121)
+    overlap_collectives: bool = True
+    # sample interval stats every N cycles (reference: gpu_stat_sample_freq)
+    stat_sample_cycles: int = 100_000
+    # deadlock detection (reference: -gpu_deadlock_detect)
+    deadlock_detect: bool = True
+    deadlock_cycles: int = 1_000_000_000
+    # default trip count for while loops whose bound isn't in the HLO
+    default_loop_trip_count: int = 1
+    # power model on/off (reference: -power_simulation_enabled)
+    power_enabled: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Overlay / composition
+# ---------------------------------------------------------------------------
+
+
+def _overlay_dataclass(obj: Any, updates: dict[str, Any]) -> Any:
+    """Return a copy of frozen dataclass ``obj`` with ``updates`` applied.
+    Nested dataclasses accept nested dicts."""
+    kw: dict[str, Any] = {}
+    valid = {f.name: f for f in fields(obj)}
+    for key, val in updates.items():
+        if key not in valid:
+            raise KeyError(
+                f"unknown config key {key!r} for {type(obj).__name__}; "
+                f"valid: {sorted(valid)}"
+            )
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(val, dict):
+            kw[key] = _overlay_dataclass(cur, val)
+        elif isinstance(cur, dict) and isinstance(val, dict):
+            merged = dict(cur)
+            merged.update(val)
+            kw[key] = merged
+        else:
+            kw[key] = val
+    return dataclasses.replace(obj, **kw)
+
+
+def overlay(config: Any, *layers: dict[str, Any]) -> Any:
+    """Apply overlay dicts in order — the ``append_gpgpusim_config`` pattern
+    (later layers win)."""
+    for layer in layers:
+        config = _overlay_dataclass(config, layer)
+    return config
+
+
+def parse_flag_file(path: str | Path) -> dict[str, Any]:
+    """Parse a reference-style flag file (``-key value`` lines, ``#``/``//``
+    comments) into an overlay dict.  Dotted keys reach nested configs:
+    ``-arch.ici.link_bandwidth 9e10``."""
+    updates: dict[str, Any] = {}
+    for raw in Path(path).read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or line.startswith("//"):
+            continue
+        if not line.startswith("-"):
+            continue
+        key, _, val = line[1:].partition(" ")
+        val = val.strip()
+        parsed: Any
+        try:
+            parsed = json.loads(val)
+        except (json.JSONDecodeError, ValueError):
+            parsed = val
+        node = updates
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = parsed
+    return updates
+
+
+def load_config(
+    base: "SimConfig | None" = None,
+    *,
+    arch: str | None = None,
+    overlays: list[dict[str, Any] | str | Path] | None = None,
+) -> SimConfig:
+    """Compose a SimConfig: named arch preset + overlay dicts / flag files /
+    JSON files, in order."""
+    from tpusim.timing.arch import arch_preset
+
+    cfg = base or SimConfig()
+    if arch is not None:
+        cfg = dataclasses.replace(cfg, arch=arch_preset(arch))
+    for item in overlays or []:
+        if isinstance(item, (str, Path)):
+            p = Path(item)
+            if p.suffix == ".json":
+                layer = json.loads(p.read_text())
+            else:
+                layer = parse_flag_file(p)
+        else:
+            layer = item
+        cfg = overlay(cfg, layer)
+    return cfg

@@ -1,0 +1,381 @@
+"""Per-op / per-fusion cost model.
+
+The TPU rebuild of the reference's opcode→unit/latency machinery: the
+``ISA_Def`` opcode maps (``volta_opcode.h``), the ``trace.config`` latency
+tables (``trace_config::set_latency``, ``trace_driven.cc:385-480``), and the
+memory coalescer (``warp_inst_t::generate_mem_accesses``,
+``abstract_hardware_model.cc:284``).  Where the reference routes each SASS
+opcode to SP/DP/INT/SFU/TENSOR pipelines with fixed latencies, we route each
+HLO op to MXU/VPU/scalar/transpose/DMA/ICI and compute a roofline time from
+its actual shapes:
+
+    cycles = overhead + max(compute_cycles, hbm_bytes / hbm_bytes_per_cycle)
+
+MXU compute time uses a systolic-pass model (fill/drain + streamed rows,
+tiles distributed over the MXUs); fusions are costed by walking their called
+computations — the analogue of the per-fusion problem called out as the
+"hard part" in SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+from tpusim.ir import (
+    Computation,
+    FREE_OPCODES,
+    ModuleTrace,
+    TensorSpec,
+    TraceOp,
+    Unit,
+    leaves_of,
+)
+from tpusim.timing.config import ArchConfig
+
+__all__ = ["OpCost", "CostModel", "dot_dims", "conv_dims", "while_trip_count"]
+
+
+# ---------------------------------------------------------------------------
+# Opcode categories (the ISA_Def tables)
+# ---------------------------------------------------------------------------
+
+TRANSCENDENTAL_OPS = frozenset({
+    "exponential", "exponential-minus-one", "log", "log-plus-one", "tanh",
+    "sqrt", "rsqrt", "cbrt", "power", "sine", "cosine", "tan", "atan2",
+    "erf", "logistic", "divide", "remainder",
+})
+
+ELEMENTWISE_OPS = frozenset({
+    "add", "subtract", "multiply", "maximum", "minimum", "and", "or", "xor",
+    "not", "negate", "abs", "sign", "compare", "select", "clamp", "floor",
+    "ceil", "round-nearest-afz", "round-nearest-even", "convert",
+    "is-finite", "shift-left", "shift-right-arithmetic",
+    "shift-right-logical", "popcnt", "count-leading-zeros", "stochastic-convert",
+    "real", "imag", "complex", "map", "reduce-precision",
+})
+
+DATA_MOVEMENT_OPS = frozenset({
+    "copy", "reshape", "slice", "dynamic-slice", "dynamic-update-slice",
+    "concatenate", "pad", "reverse", "broadcast", "iota", "gather",
+    "scatter", "set-dimension-size",
+})
+
+REDUCE_OPS = frozenset({"reduce", "reduce-window", "select-and-scatter"})
+
+_TRIP_COUNT_RE = re.compile(r'known_trip_count[^0-9]*?(\d+)')
+_INDUCTION_RE = re.compile(r'known_induction_variable')
+
+
+# ---------------------------------------------------------------------------
+# Structured attr helpers
+# ---------------------------------------------------------------------------
+
+
+def _int_set(attrs: dict[str, str], key: str) -> tuple[int, ...]:
+    val = attrs.get(key, "")
+    val = val.strip().strip("{}")
+    return tuple(int(x) for x in val.split(",") if x.strip())
+
+
+def dot_dims(
+    op: TraceOp, comp: Computation
+) -> tuple[int, int, int, int, str]:
+    """(batch, M, N, K, dtype) of a dot, from its operand shapes + dims."""
+    lhs = _leaf_shape(comp, op.operands[0])
+    rhs = _leaf_shape(comp, op.operands[1])
+    lc = _int_set(op.attrs, "lhs_contracting_dims")
+    rc = _int_set(op.attrs, "rhs_contracting_dims")
+    lb = _int_set(op.attrs, "lhs_batch_dims")
+    rb = _int_set(op.attrs, "rhs_batch_dims")
+    b = math.prod(lhs.shape[i] for i in lb) if lb else 1
+    k = math.prod(lhs.shape[i] for i in lc) if lc else 1
+    m = math.prod(
+        d for i, d in enumerate(lhs.shape) if i not in lc and i not in lb
+    ) if lhs.shape else 1
+    n = math.prod(
+        d for i, d in enumerate(rhs.shape) if i not in rc and i not in rb
+    ) if rhs.shape else 1
+    return b, m, n, k, lhs.dtype
+
+
+_WINDOW_SIZE_RE = re.compile(r"size=([0-9x]+)")
+
+
+def conv_dims(
+    op: TraceOp, comp: Computation
+) -> tuple[int, int, int, int, str]:
+    """Convolution as an implicit matmul: (batch=1, M, N, K, dtype) with
+    M = output spatial positions × batch, N = output features,
+    K = kernel spatial × input features / feature_groups."""
+    rhs = _leaf_shape(comp, op.operands[1])
+    out = leaves_of(op.result)[0]
+    window = op.attrs.get("window", "")
+    m_sz = _WINDOW_SIZE_RE.search(window)
+    kernel_spatial = 1
+    if m_sz:
+        for d in m_sz.group(1).split("x"):
+            kernel_spatial *= int(d)
+    fgc = int(op.attrs.get("feature_group_count", "1") or 1)
+    bgc = int(op.attrs.get("batch_group_count", "1") or 1)
+    dim_labels = op.attrs.get("dim_labels", "")
+    # rhs labels sit between '_' and '->': e.g. b01f_01io->b01f
+    in_feat = out_feat = None
+    if "_" in dim_labels and "->" in dim_labels:
+        rhs_labels = dim_labels.split("_")[1].split("->")[0]
+        for pos, ch in enumerate(rhs_labels):
+            if ch == "i" and pos < len(rhs.shape):
+                in_feat = rhs.shape[pos]
+            elif ch == "o" and pos < len(rhs.shape):
+                out_feat = rhs.shape[pos]
+    if out_feat is None:
+        out_feat = out.shape[-1] if out.shape else 1
+    if in_feat is None:
+        in_feat = rhs.shape[-2] if len(rhs.shape) >= 2 else 1
+    m = max(out.elems // max(out_feat, 1), 1)
+    k = max(kernel_spatial * in_feat // max(fgc * bgc, 1), 1)
+    lhs = _leaf_shape(comp, op.operands[0])
+    return 1, m, out_feat, k, lhs.dtype
+
+
+def while_trip_count(op: TraceOp, default: int = 1) -> int:
+    """Trip count of a while op, from XLA's ``known_trip_count`` backend
+    config when present (lax.scan/fori_loop produce it)."""
+    bc = op.attrs.get("backend_config", "")
+    m = _TRIP_COUNT_RE.search(bc)
+    if m:
+        return int(m.group(1))
+    return default
+
+
+def _leaf_shape(comp: Computation, operand: str) -> TensorSpec:
+    """Resolve an operand name to its (first leaf) TensorSpec."""
+    if comp.has_op(operand):
+        leaves = leaves_of(comp.op(operand).result)
+        if leaves:
+            return leaves[0]
+    return TensorSpec("f32", ())
+
+
+def _operand_bytes(comp: Computation, op: TraceOp) -> int:
+    total = 0
+    seen = set()
+    for name in op.operands:
+        if name in seen:
+            continue
+        seen.add(name)
+        if comp.has_op(name):
+            total += comp.op(name).result.nbytes
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Cost record
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OpCost:
+    """Timing + accounting for one scheduled op."""
+
+    cycles: float = 0.0
+    compute_cycles: float = 0.0
+    mem_cycles: float = 0.0
+    unit: Unit = Unit.NONE
+    flops: float = 0.0
+    mxu_flops: float = 0.0
+    transcendentals: float = 0.0
+    hbm_bytes: float = 0.0
+    ici_bytes: float = 0.0
+    is_async: bool = False
+
+    def add_compute(self, other: "OpCost") -> None:
+        self.compute_cycles += other.compute_cycles
+        self.flops += other.flops
+        self.mxu_flops += other.mxu_flops
+        self.transcendentals += other.transcendentals
+
+
+# ---------------------------------------------------------------------------
+# The model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CostModel:
+    arch: ArchConfig
+    #: per-custom-call-target achieved-FLOP/s override (e.g. pallas kernels)
+    custom_call_flops: dict[str, float] = field(default_factory=dict)
+
+    # -- MXU systolic-pass model ------------------------------------------
+
+    def mxu_cycles(self, b: int, m: int, n: int, k: int, dtype: str) -> float:
+        """Cycles for a (possibly batched) matmul on the MXU array.
+
+        The K dimension maps to the systolic rows, N to the columns, M rows
+        stream through; tiles are distributed across the ``mxu_count``
+        arrays.  Fill/drain is paid once per pass — this is what makes small
+        matmuls MXU-inefficient, the analogue of the reference's tensor-core
+        initiation intervals (``trace.config`` tensor 2,2)."""
+        a = self.arch
+        passes = b * math.ceil(k / a.mxu_rows) * math.ceil(n / a.mxu_cols)
+        m_pad = max(8, math.ceil(m / 8) * 8)
+        per_pass = m_pad + a.mxu_fill_cycles
+        serial = math.ceil(passes / a.mxu_count)
+        return serial * per_pass / max(a.mxu_dtype_mult(dtype), 1e-6)
+
+    def _vpu_cycles(self, elem_ops: float, transcendentals: float) -> float:
+        a = self.arch
+        return (
+            elem_ops / a.vpu_flops_per_cycle
+            + transcendentals / a.vpu_transcendental_per_cycle
+        )
+
+    # -- per-op compute cost (no memory term) ------------------------------
+
+    def _compute_cost(self, op: TraceOp, comp: Computation,
+                      module: ModuleTrace, depth: int = 0) -> OpCost:
+        c = OpCost()
+        base = op.base
+        out_elems = op.result.elems
+
+        if base in FREE_OPCODES or op.opcode in FREE_OPCODES:
+            return c
+
+        if base == "dot":
+            b, m, n, k, dt = dot_dims(op, comp)
+            c.compute_cycles = self.mxu_cycles(b, m, n, k, dt)
+            c.flops = c.mxu_flops = 2.0 * b * m * n * k
+            c.unit = Unit.MXU
+        elif base == "convolution":
+            b, m, n, k, dt = conv_dims(op, comp)
+            c.compute_cycles = self.mxu_cycles(b, m, n, k, dt)
+            c.flops = c.mxu_flops = 2.0 * b * m * n * k
+            c.unit = Unit.MXU
+        elif base == "fusion" and op.called:
+            inner = self.fused_compute_cost(module, op.called[0], depth + 1)
+            c.add_compute(inner)
+            c.unit = Unit.MXU if inner.mxu_flops > 0 else Unit.VPU
+        elif base in TRANSCENDENTAL_OPS:
+            c.transcendentals = float(out_elems)
+            c.flops = float(out_elems)
+            c.compute_cycles = self._vpu_cycles(0, c.transcendentals)
+            c.unit = Unit.VPU
+        elif base in ELEMENTWISE_OPS:
+            c.flops = float(out_elems)
+            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.unit = Unit.VPU
+        elif base in REDUCE_OPS:
+            in_elems = sum(
+                _leaf_shape(comp, o).elems for o in op.operands[:1]
+            )
+            if base == "reduce-window":
+                m_sz = _WINDOW_SIZE_RE.search(op.attrs.get("window", ""))
+                wnd = 1
+                if m_sz:
+                    for d in m_sz.group(1).split("x"):
+                        wnd *= int(d)
+                in_elems *= max(wnd, 1)
+            c.flops = float(in_elems)
+            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.unit = Unit.VPU
+        elif base == "transpose":
+            c.unit = Unit.TRANSPOSE
+            # handled by memory term; transpose unit streams at vector rate
+            c.compute_cycles = out_elems / self.arch.vpu_flops_per_cycle
+        elif base in DATA_MOVEMENT_OPS:
+            c.unit = Unit.DMA
+        elif base == "sort":
+            n_el = float(max(out_elems, 2))
+            c.flops = n_el * math.log2(n_el) * 4.0
+            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.unit = Unit.VPU
+        elif base in ("rng", "rng-bit-generator", "rng-get-and-update-state"):
+            c.flops = float(out_elems) * 8.0
+            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.unit = Unit.VPU
+        elif base == "custom-call":
+            target = op.attrs.get("custom_call_target", "").strip('"')
+            rate = self.custom_call_flops.get(target)
+            if rate and rate > 0:
+                # caller recorded achieved FLOP/s for this kernel target
+                c.flops = float(out_elems)
+                c.compute_cycles = (
+                    c.flops / rate * self.arch.clock_hz
+                )
+            c.unit = Unit.VPU
+        elif base in ("infeed", "outfeed", "send", "recv"):
+            c.unit = Unit.DMA
+        else:
+            # unknown compute op: elementwise-cost fallback
+            c.flops = float(out_elems)
+            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.unit = Unit.VPU
+        return c
+
+    def fused_compute_cost(
+        self, module: ModuleTrace, comp_name: str, depth: int = 0
+    ) -> OpCost:
+        """Aggregate compute cost of a fused computation (recursive)."""
+        if depth > 16:
+            return OpCost()
+        total = OpCost()
+        if comp_name not in module.computations:
+            return total
+        comp = module.computation(comp_name)
+        for op in comp.ops:
+            inner = self._compute_cost(op, comp, module, depth)
+            total.add_compute(inner)
+        return total
+
+    # -- full op cost ------------------------------------------------------
+
+    def op_cost(
+        self, op: TraceOp, comp: Computation, module: ModuleTrace
+    ) -> OpCost:
+        """Roofline cost of one scheduled (entry-level) op.  Collectives get
+        ``ici_bytes`` filled but no time here — the engine prices them on
+        the ICI via the collective model; ``while``/``conditional``/``call``
+        get no time here — the engine recurses into their bodies."""
+        a = self.arch
+        base = op.base
+
+        if base in FREE_OPCODES or op.opcode in FREE_OPCODES:
+            return OpCost(unit=Unit.NONE)
+
+        if op.is_collective:
+            c = OpCost(unit=Unit.ICI, is_async=op.is_async_start)
+            c.ici_bytes = self.collective_payload_bytes(op, comp)
+            return c
+        if op.is_async_done or base in ("while", "conditional", "call"):
+            return OpCost(unit=Unit.NONE)
+
+        c = self._compute_cost(op, comp, module)
+        c.hbm_bytes = float(_operand_bytes(comp, op) + op.result.nbytes)
+        if base == "fusion":
+            # async-fused copies inside don't re-read; roofline over operands
+            # + outputs is the standard fusion assumption (SURVEY.md §7)
+            pass
+        c.mem_cycles = c.hbm_bytes / a.hbm_bytes_per_cycle
+        c.cycles = a.op_overhead_cycles + max(c.compute_cycles, c.mem_cycles)
+        c.is_async = op.is_async_start
+        if op.opcode in ("copy-start",):
+            c.unit = Unit.DMA
+        return c
+
+    # -- collectives -------------------------------------------------------
+
+    def collective_payload_bytes(self, op: TraceOp, comp: Computation) -> float:
+        """Per-participant payload: input bytes for reduce-ish ops, full
+        gathered bytes for all-gather (its cost formula expects the output
+        size)."""
+        base = op.base
+        if base in ("all-gather", "collective-broadcast"):
+            leaves = leaves_of(op.result)
+            return float(max((l.nbytes for l in leaves), default=0))
+        inb = _operand_bytes(comp, op)
+        if inb:
+            return float(inb)
+        leaves = leaves_of(op.result)
+        return float(max((l.nbytes for l in leaves), default=0))

@@ -1,0 +1,321 @@
+"""Schedule-walking timing engine.
+
+The rebuild of the reference's top-level cycle loop (``gpgpu_sim::cycle``,
+``gpu-sim.cc:1871-2110``) at HLO granularity.  A TPU TensorCore executes its
+scheduled program **sequentially**, with asynchronous DMA and ICI transfers
+explicitly bracketed in the HLO as ``*-start`` / ``*-done`` pairs — so rather
+than a 4-clock-domain pipeline simulation, the engine walks the schedule
+advancing a core clock, runs async transfers on ICI/DMA resource timelines,
+and joins at the ``-done`` ops.  This is precisely the compute/collective
+overlap the distributed fork could not model (its NCCL latency is added
+serially, ``main.cc:121``; SURVEY.md §5 calls this out as the gap to fix).
+
+``while`` bodies (e.g. lax.scan training loops, ring-attention ppermute
+chains) are recursed into and multiplied by the trip count XLA records in
+``backend_config.known_trip_count``.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.topology import Topology, torus_for
+from tpusim.ir import Computation, ModuleTrace, TraceOp, Unit
+from tpusim.timing.config import SimConfig
+from tpusim.timing.cost import CostModel, OpCost, while_trip_count
+
+__all__ = ["Engine", "EngineResult", "TimelineEvent"]
+
+
+@dataclass
+class TimelineEvent:
+    name: str
+    opcode: str
+    unit: str
+    start_cycle: float
+    end_cycle: float
+
+
+@dataclass
+class EngineResult:
+    """Counters for one simulated module execution — the equivalent of the
+    reference's ~300 ``gpu_print_stat`` counters (``gpu-sim.h:550-579``)."""
+
+    cycles: float = 0.0
+    seconds: float = 0.0
+    op_count: int = 0
+    flops: float = 0.0
+    mxu_flops: float = 0.0
+    transcendentals: float = 0.0
+    hbm_bytes: float = 0.0
+    ici_bytes: float = 0.0
+    collective_count: int = 0
+    collective_cycles: float = 0.0       # total ICI busy cycles
+    exposed_collective_cycles: float = 0.0  # cycles the core waited on ICI
+    dma_cycles: float = 0.0
+    exposed_dma_cycles: float = 0.0
+    unit_busy_cycles: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    opcode_cycles: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    timeline: list[TimelineEvent] = field(default_factory=list)
+
+    # -- derived -----------------------------------------------------------
+
+    @property
+    def mxu_utilization(self) -> float:
+        busy = self.unit_busy_cycles.get(Unit.MXU.value, 0.0)
+        return busy / self.cycles if self.cycles else 0.0
+
+    @property
+    def achieved_flops(self) -> float:
+        return self.flops / self.seconds if self.seconds else 0.0
+
+    @property
+    def hbm_gbps(self) -> float:
+        return self.hbm_bytes / self.seconds / 1e9 if self.seconds else 0.0
+
+    def merge_scaled(self, other: "EngineResult", times: float = 1.0) -> None:
+        """Accumulate a sub-result (e.g. a while body × trip count)."""
+        self.op_count += int(other.op_count * times)
+        self.flops += other.flops * times
+        self.mxu_flops += other.mxu_flops * times
+        self.transcendentals += other.transcendentals * times
+        self.hbm_bytes += other.hbm_bytes * times
+        self.ici_bytes += other.ici_bytes * times
+        self.collective_count += int(other.collective_count * times)
+        self.collective_cycles += other.collective_cycles * times
+        self.exposed_collective_cycles += other.exposed_collective_cycles * times
+        self.dma_cycles += other.dma_cycles * times
+        self.exposed_dma_cycles += other.exposed_dma_cycles * times
+        for k, v in other.unit_busy_cycles.items():
+            self.unit_busy_cycles[k] += v * times
+        for k, v in other.opcode_cycles.items():
+            self.opcode_cycles[k] += v * times
+
+    def stats_dict(self) -> dict[str, float]:
+        d = {
+            "sim_cycles": self.cycles,
+            "sim_seconds": self.seconds,
+            "op_count": self.op_count,
+            "flops": self.flops,
+            "mxu_flops": self.mxu_flops,
+            "hbm_bytes": self.hbm_bytes,
+            "ici_bytes": self.ici_bytes,
+            "collective_count": self.collective_count,
+            "collective_cycles": self.collective_cycles,
+            "exposed_collective_cycles": self.exposed_collective_cycles,
+            "dma_cycles": self.dma_cycles,
+            "exposed_dma_cycles": self.exposed_dma_cycles,
+            "mxu_utilization": self.mxu_utilization,
+            "achieved_tflops": self.achieved_flops / 1e12,
+            "hbm_gbps": self.hbm_gbps,
+        }
+        for unit, busy in self.unit_busy_cycles.items():
+            d[f"busy_cycles_{unit}"] = busy
+        return d
+
+
+class Engine:
+    """Times one module on one modeled device of a topology."""
+
+    def __init__(
+        self,
+        config: SimConfig,
+        topology: Topology | None = None,
+        cost_model: CostModel | None = None,
+        record_timeline: bool = False,
+        max_timeline_events: int = 100_000,
+    ):
+        self.config = config
+        self.arch = config.arch
+        self.cost = cost_model or CostModel(self.arch)
+        self.topology = topology
+        self.record_timeline = record_timeline
+        self.max_timeline_events = max_timeline_events
+
+    def _topology_for(self, module: ModuleTrace) -> Topology:
+        if self.topology is not None:
+            return self.topology
+        return torus_for(module.num_devices, self.arch.name)
+
+    # ------------------------------------------------------------------
+
+    def run(self, module: ModuleTrace) -> EngineResult:
+        """Simulate one execution of the module's entry computation."""
+        topo = self._topology_for(module)
+        coll = CollectiveModel(topo, self.arch.ici)
+        result = EngineResult()
+        end = self._run_computation(
+            module, module.entry, t0=0.0, coll=coll, result=result, depth=0
+        )
+        result.cycles = end
+        result.seconds = self.arch.cycles_to_seconds(end)
+        return result
+
+    # ------------------------------------------------------------------
+
+    def _run_computation(
+        self,
+        module: ModuleTrace,
+        comp: Computation,
+        t0: float,
+        coll: CollectiveModel,
+        result: EngineResult,
+        depth: int,
+    ) -> float:
+        """Walk one computation's schedule; returns the finish cycle."""
+        if depth > 32:
+            return t0
+        a = self.arch
+        t = t0
+        ici_free = t0
+        dma_free = t0
+        pending: dict[str, float] = {}  # async op name -> finish cycle
+        overlap = self.config.overlap_collectives
+
+        for op in comp.ops:
+            base = op.base
+
+            # ---- control flow: recurse ---------------------------------
+            if base == "while" and len(op.called) >= 1:
+                body_name = op.attrs.get("body", "").lstrip("%") or op.called[0]
+                trips = while_trip_count(
+                    op, self.config.default_loop_trip_count
+                )
+                sub = EngineResult()
+                body_end = self._run_computation(
+                    module, module.computation(body_name), 0.0, coll, sub,
+                    depth + 1,
+                )
+                result.merge_scaled(sub, float(trips))
+                dur = body_end * trips + a.op_overhead_cycles * (trips + 1)
+                self._emit(result, op, t, t + dur, Unit.SCALAR)
+                t += dur
+                result.op_count += 1
+                continue
+            if base == "conditional" and op.called:
+                durs = []
+                subs = []
+                for branch in op.called:
+                    if branch not in module.computations:
+                        continue
+                    sub = EngineResult()
+                    d = self._run_computation(
+                        module, module.computation(branch), 0.0, coll, sub,
+                        depth + 1,
+                    )
+                    durs.append(d)
+                    subs.append(sub)
+                if durs:
+                    worst = max(range(len(durs)), key=lambda i: durs[i])
+                    result.merge_scaled(subs[worst], 1.0)
+                    dur = durs[worst] + a.op_overhead_cycles
+                    self._emit(result, op, t, t + dur, Unit.SCALAR)
+                    t += dur
+                result.op_count += 1
+                continue
+            if base == "call" and op.called:
+                sub = EngineResult()
+                d = self._run_computation(
+                    module, module.computation(op.called[0]), 0.0, coll, sub,
+                    depth + 1,
+                )
+                result.merge_scaled(sub, 1.0)
+                self._emit(result, op, t, t + d, Unit.SCALAR)
+                t += d
+                result.op_count += 1
+                continue
+
+            # ---- async joins -------------------------------------------
+            if op.is_async_done:
+                src = op.operands[0] if op.operands else None
+                finish = pending.pop(src, t)
+                waited = max(0.0, finish - t)
+                if op.base in ("all-reduce", "all-gather", "reduce-scatter",
+                               "all-to-all", "collective-permute",
+                               "collective-broadcast", "ragged-all-to-all"):
+                    result.exposed_collective_cycles += waited
+                else:
+                    result.exposed_dma_cycles += waited
+                t = max(t, finish)
+                result.op_count += 1
+                continue
+
+            cost = self.cost.op_cost(op, comp, module)
+
+            # ---- collectives -------------------------------------------
+            if op.is_collective:
+                seconds = coll.seconds(op.collective, cost.ici_bytes)
+                dur = a.seconds_to_cycles(seconds)
+                result.collective_count += 1
+                result.ici_bytes += cost.ici_bytes
+                result.collective_cycles += dur
+                result.unit_busy_cycles[Unit.ICI.value] += dur
+                result.opcode_cycles[base] += dur
+                if op.is_async_start and overlap:
+                    start = max(t, ici_free)
+                    pending[op.name] = start + dur
+                    ici_free = start + dur
+                    self._emit(result, op, start, start + dur, Unit.ICI)
+                    t += a.op_overhead_cycles  # issue cost on the core
+                else:
+                    start = max(t, ici_free)
+                    self._emit(result, op, start, start + dur, Unit.ICI)
+                    t = start + dur
+                    ici_free = t
+                    result.exposed_collective_cycles += dur
+                result.op_count += 1
+                continue
+
+            # ---- async DMA (copy-start etc.) ---------------------------
+            if op.is_async_start:
+                dur = cost.cycles
+                start = max(t, dma_free)
+                pending[op.name] = start + dur
+                dma_free = start + dur
+                result.dma_cycles += dur
+                result.unit_busy_cycles[Unit.DMA.value] += dur
+                result.opcode_cycles[base] += dur
+                result.hbm_bytes += cost.hbm_bytes
+                self._emit(result, op, start, start + dur, Unit.DMA)
+                t += a.op_overhead_cycles
+                result.op_count += 1
+                continue
+
+            # ---- ordinary synchronous op -------------------------------
+            dur = cost.cycles
+            if dur > 0:
+                self._emit(result, op, t, t + dur, cost.unit)
+            t += dur
+            result.op_count += 1
+            result.flops += cost.flops
+            result.mxu_flops += cost.mxu_flops
+            result.transcendentals += cost.transcendentals
+            result.hbm_bytes += cost.hbm_bytes
+            if dur > 0:
+                result.unit_busy_cycles[cost.unit.value] += dur
+                result.opcode_cycles[base] += dur
+
+        # drain: the program isn't done until pending transfers complete
+        for finish in pending.values():
+            t = max(t, finish)
+        return t
+
+    # ------------------------------------------------------------------
+
+    def _emit(
+        self, result: EngineResult, op: TraceOp, start: float, end: float,
+        unit: Unit,
+    ) -> None:
+        if not self.record_timeline:
+            return
+        if len(result.timeline) >= self.max_timeline_events:
+            return
+        result.timeline.append(
+            TimelineEvent(op.name, op.opcode, unit.value, start, end)
+        )
