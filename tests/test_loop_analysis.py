@@ -1,0 +1,130 @@
+"""Tests for while-loop trip-count inference (tpusim/trace/loop_analysis.py)."""
+
+from tpusim.trace.hlo_text import parse_hlo_module
+from tpusim.trace.loop_analysis import infer_trip_count
+
+
+def _loop_module(start: int, bound: int, step: int, direction: str = "LT"):
+    return parse_hlo_module(f"""
+HloModule loop
+
+%body (p: (s32[], f32[8])) -> (s32[], f32[8]) {{
+  %p = (s32[], f32[8]) parameter(0)
+  %iv = s32[] get-tuple-element(%p), index=0
+  %cstep = s32[] constant({step})
+  %next = s32[] add(%iv, %cstep)
+  %x = f32[8]{{0}} get-tuple-element(%p), index=1
+  %y = f32[8]{{0}} add(%x, %x)
+  ROOT %t = (s32[], f32[8]) tuple(%next, %y)
+}}
+
+%cond (p2: (s32[], f32[8])) -> pred[] {{
+  %p2 = (s32[], f32[8]) parameter(0)
+  %iv2 = s32[] get-tuple-element(%p2), index=0
+  %bound = s32[] constant({bound})
+  ROOT %cmp = pred[] compare(%iv2, %bound), direction={direction}
+}}
+
+ENTRY %main (a: f32[8]) -> (s32[], f32[8]) {{
+  %a = f32[8]{{0}} parameter(0)
+  %c0 = s32[] constant({start})
+  %init = (s32[], f32[8]) tuple(%c0, %a)
+  ROOT %w = (s32[], f32[8]) while(%init), condition=%cond, body=%body
+}}
+""")
+
+
+def _trips(mod):
+    entry = mod.entry
+    return infer_trip_count(mod, entry, entry.op("w"), default=-1)
+
+
+def test_basic_lt():
+    assert _trips(_loop_module(0, 32, 1)) == 32
+
+
+def test_nonzero_start_and_step():
+    assert _trips(_loop_module(4, 32, 2)) == 14
+    assert _trips(_loop_module(0, 10, 3)) == 4  # ceil(10/3)
+
+
+def test_le_direction():
+    assert _trips(_loop_module(0, 9, 1, "LE")) == 10
+
+
+def test_countdown_gt():
+    mod = parse_hlo_module("""
+HloModule loop
+
+%body (p: (s32[], f32[8])) -> (s32[], f32[8]) {
+  %p = (s32[], f32[8]) parameter(0)
+  %iv = s32[] get-tuple-element(%p), index=0
+  %cstep = s32[] constant(1)
+  %next = s32[] subtract(%iv, %cstep)
+  %x = f32[8]{0} get-tuple-element(%p), index=1
+  ROOT %t = (s32[], f32[8]) tuple(%next, %x)
+}
+
+%cond (p2: (s32[], f32[8])) -> pred[] {
+  %p2 = (s32[], f32[8]) parameter(0)
+  %iv2 = s32[] get-tuple-element(%p2), index=0
+  %zero = s32[] constant(0)
+  ROOT %cmp = pred[] compare(%iv2, %zero), direction=GT
+}
+
+ENTRY %main (a: f32[8]) -> (s32[], f32[8]) {
+  %a = f32[8]{0} parameter(0)
+  %c = s32[] constant(7)
+  %init = (s32[], f32[8]) tuple(%c, %a)
+  ROOT %w = (s32[], f32[8]) while(%init), condition=%cond, body=%body
+}
+""")
+    assert _trips(mod) == 7
+
+
+def test_unrecognized_falls_back_to_default():
+    mod = parse_hlo_module("""
+HloModule loop
+
+%body (p: f32[8]) -> f32[8] {
+  %p = f32[8]{0} parameter(0)
+  ROOT %y = f32[8]{0} add(%p, %p)
+}
+
+%cond (p2: f32[8]) -> pred[] {
+  %p2 = f32[8]{0} parameter(0)
+  ROOT %c = pred[] constant(true)
+}
+
+ENTRY %main (a: f32[8]) -> f32[8] {
+  %a = f32[8]{0} parameter(0)
+  ROOT %w = f32[8]{0} while(%a), condition=%cond, body=%body
+}
+""")
+    entry = mod.entry
+    assert infer_trip_count(mod, entry, entry.op("w"), default=5) == 5
+
+
+def test_real_scan_capture_roundtrip():
+    """A jax.lax.scan captured on the live backend must get its length
+    recovered (backend_config is absent on some backends)."""
+    import jax
+
+    from tpusim.tracer.capture import capture
+    from tpusim.timing.config import SimConfig
+    from tpusim.timing.engine import Engine
+
+    K = 17
+
+    def f(x):
+        def body(h, _):
+            return h @ h, ()
+        out, _ = jax.lax.scan(body, x, None, length=K)
+        return out
+
+    import jax.numpy as jnp
+
+    cap = capture(f, jnp.eye(256, dtype=jnp.float32), name="scan17")
+    res = Engine(SimConfig()).run(cap.module)
+    # 17 iterations of a 256^3 matmul
+    assert res.mxu_flops >= K * 2 * 256 ** 3 * 0.99

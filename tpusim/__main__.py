@@ -1,0 +1,100 @@
+"""Command-line interface — the ``accel-sim.out`` equivalent.
+
+    python -m tpusim simulate <trace-dir> [--arch v5p] [--config file ...]
+    python -m tpusim capture  <workload> <out-dir> [--launches N]
+    python -m tpusim info     <trace-dir>
+
+``simulate`` mirrors ``accel-sim.out -trace kernelslist.g -config
+gpgpusim.config`` (``gpu-simulator/main.cc:55-206``); ``capture`` mirrors
+``run_hw_trace.py``; workload names come from the registry in
+:mod:`tpusim.models`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_simulate(args: argparse.Namespace) -> int:
+    from tpusim.sim.driver import simulate_trace
+
+    report = simulate_trace(
+        args.trace, arch=args.arch, overlays=list(args.config or [])
+    )
+    report.print_report()
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(report.stats.to_json() + "\n")
+    return 0
+
+
+def _cmd_capture(args: argparse.Namespace) -> int:
+    from tpusim.models import get_workload
+    from tpusim.tracer.capture import capture_to_dir
+
+    wl = get_workload(args.workload)
+    fn, wl_args = wl.build()
+    capture_to_dir(
+        args.out, fn, *wl_args, name=wl.name, launches=args.launches
+    )
+    print(f"trace written to {args.out}")
+    return 0
+
+
+def _cmd_info(args: argparse.Namespace) -> int:
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    info = {
+        "meta": pod.meta,
+        "modules": {
+            name: {
+                "computations": len(m.computations),
+                "entry_ops": len(m.entry.ops) if m.entry_name else 0,
+                "collectives": len(m.collectives()),
+                "num_devices": m.num_devices,
+            }
+            for name, m in pod.modules.items()
+        },
+        "devices": {
+            d: len(t.commands) for d, t in pod.devices.items()
+        },
+    }
+    print(json.dumps(info, indent=2, default=str))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpusim")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("simulate", help="replay a stored trace")
+    ps.add_argument("trace")
+    ps.add_argument("--arch", default=None, help="arch preset (v4/v5e/v5p/v6e)")
+    ps.add_argument("--config", action="append",
+                    help="overlay flag file(s), applied in order")
+    ps.add_argument("--json", default=None, help="also write stats JSON here")
+    ps.set_defaults(fn=_cmd_simulate)
+
+    pc = sub.add_parser("capture", help="capture a registered workload")
+    pc.add_argument("workload")
+    pc.add_argument("out")
+    pc.add_argument("--launches", type=int, default=1)
+    pc.set_defaults(fn=_cmd_capture)
+
+    pi = sub.add_parser("info", help="describe a stored trace")
+    pi.add_argument("trace")
+    pi.set_defaults(fn=_cmd_info)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (KeyError, FileNotFoundError) as e:
+        print(f"tpusim: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

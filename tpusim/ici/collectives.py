@@ -29,10 +29,13 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from typing import TYPE_CHECKING
 
 from tpusim.ir import CollectiveInfo
-from tpusim.timing.config import IciConfig
 from tpusim.ici.topology import Topology
+
+if TYPE_CHECKING:  # avoid a circular import with tpusim.timing
+    from tpusim.timing.config import IciConfig
 
 __all__ = ["CollectiveModel", "collective_seconds"]
 
@@ -40,7 +43,7 @@ __all__ = ["CollectiveModel", "collective_seconds"]
 @dataclass
 class CollectiveModel:
     topo: Topology
-    cfg: IciConfig
+    cfg: "IciConfig"
 
     # -- helpers -----------------------------------------------------------
 
@@ -180,6 +183,6 @@ def collective_seconds(
     info: CollectiveInfo,
     payload_bytes: float,
     topo: Topology,
-    cfg: IciConfig,
+    cfg: "IciConfig",
 ) -> float:
     return CollectiveModel(topo, cfg).seconds(info, payload_bytes)

@@ -311,7 +311,13 @@ class ModuleTrace:
         return self.computations[self.entry_name]
 
     def computation(self, name: str) -> Computation:
-        return self.computations[name]
+        try:
+            return self.computations[name]
+        except KeyError:
+            raise KeyError(
+                f"module {self.name!r} has no computation {name!r} "
+                f"(truncated trace?); has: {sorted(self.computations)[:8]}..."
+            ) from None
 
     @property
     def num_partitions(self) -> int:

@@ -1,0 +1,21 @@
+"""Workload model zoo.
+
+The reference is a simulator, so its "models" are the benchmark apps it
+traces (rodinia, deepbench, cutlass... ``util/job_launching/apps/
+define-all-apps.yml``).  Ours are JAX workloads matching the BASELINE.json
+staged configs: matmul/conv microbenches (config #3), ResNet-50 data-parallel
+(config #4), Llama-2 with pjit TP/FSDP shardings (config #5), and
+ring-attention sequence parallelism (the long-context capability slot,
+SURVEY.md §5).  Each registers a named :class:`Workload` whose ``build()``
+returns ``(jittable_fn, example_args)`` ready for the tracer.
+"""
+
+from tpusim.models.registry import Workload, get_workload, list_workloads, register
+
+# import for registration side effects
+from tpusim.models import microbench as _microbench  # noqa: F401
+from tpusim.models import resnet as _resnet  # noqa: F401
+from tpusim.models import llama as _llama  # noqa: F401
+from tpusim.models import attention as _attention  # noqa: F401
+
+__all__ = ["Workload", "get_workload", "list_workloads", "register"]

@@ -1,0 +1,153 @@
+"""Long-context attention workloads: ring attention (sequence parallelism)
+and all-to-all head parallelism (Ulysses-style).
+
+SURVEY.md §5 places long-context support in the capability slot the
+reference leaves empty: ring-attention traces are ``collective-permute``
+chains inside a loop, Ulysses traces are ``all-to-all`` pairs — both must
+get faithful ICI timing.  These workloads *generate* exactly those HLO
+patterns, TPU-natively via ``shard_map`` over an ``sp`` mesh axis with
+``jax.lax.ppermute`` / ``all_to_all``:
+
+* **ring attention**: each chip holds a sequence shard's Q,K,V; K/V blocks
+  rotate around the ring while a running flash-style softmax accumulates —
+  after N-1 rotations every Q block has attended to the full sequence.
+* **Ulysses**: all-to-all converts sequence sharding to head sharding, local
+  full-sequence attention runs, and a second all-to-all converts back.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from tpusim.models.registry import register
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _flash_block(q, k, v, scale, m_prev, l_prev, acc):
+    """One blockwise-softmax accumulation step (numerically stable)."""
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m_prev - m_new)
+    l_new = l_prev * correction + p.sum(axis=-1)
+    acc = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, acc
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Non-causal ring attention over sequence shards on ``axis_name``.
+
+    q,k,v: [B, S_local, H, D] per chip.  Returns [B, S_local, H, D].
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, s, h, d = q.shape
+    m = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    acc = jnp.zeros((b, h, s, d), jnp.float32)
+    # fresh constants are unvarying over the mesh axis; the loop carry
+    # becomes varying after the first ppermute, so align the types up front
+    if hasattr(lax, "pvary"):
+        m, l, acc = (lax.pvary(x, (axis_name,)) for x in (m, l, acc))
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        m, l, acc = _flash_block(q, k_blk, v_blk, scale, m, l, acc)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc)
+
+    k_blk, v_blk, m, l, acc = lax.fori_loop(
+        0, n, body, (k, v, m, l, acc)
+    )
+    out = acc / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str):
+    """Ulysses-style: all-to-all seq→head reshard, local attention, and
+    back.  q,k,v: [B, S_local, H, D]; H must divide the axis size."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    ql, kl, vl = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", ql, kl).astype(jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), vl)
+    return heads_to_seq(out)
+
+
+def _build_sp(kind: str, batch: int, seq: int, heads: int, head_dim: int,
+              sp: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices()[:sp])
+    mesh = Mesh(devs, ("sp",))
+    dt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, seq, heads, head_dim)
+    q = jax.random.normal(kq, shape, dt)
+    k = jax.random.normal(kk, shape, dt)
+    v = jax.random.normal(kv, shape, dt)
+
+    inner = ring_attention if kind == "ring" else ulysses_attention
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )
+    def sharded_attn(q, k, v):
+        return inner(q, k, v, "sp")
+
+    return sharded_attn, (q, k, v)
+
+
+@register(
+    "ring_attention_sp8",
+    description="ring attention over an 8-way sequence-parallel ring "
+    "(ppermute chain — long-context capability)",
+    suite="models",
+    num_devices=8,
+    kind="ring", batch=1, seq=8 * 2048, heads=16, head_dim=128, sp=8,
+    dtype="bfloat16",
+)
+def build_ring_attention(**kw):
+    return _build_sp(**kw)
+
+
+@register(
+    "ulysses_attention_sp8",
+    description="Ulysses all-to-all head-parallel attention over 8 chips",
+    suite="models",
+    num_devices=8,
+    kind="ulysses", batch=1, seq=8 * 2048, heads=16, head_dim=128, sp=8,
+    dtype="bfloat16",
+)
+def build_ulysses_attention(**kw):
+    return _build_sp(**kw)

@@ -1,0 +1,299 @@
+"""Llama-2 decoder workload (BASELINE.json config #5: pjit on a modeled
+v5p-64) — the flagship model of this framework.
+
+A faithful Llama-2 architecture in pure JAX: RMSNorm, rotary position
+embeddings, (grouped-query-capable) attention, SwiGLU MLP, weight-tied
+final projection off the embedding.  Parallelism is TPU-native GSPMD: a
+``('dp','tp')`` mesh with Megatron-style shardings — attention QKV and MLP
+up-projections column-parallel over ``tp``, output/down projections
+row-parallel, batch over ``dp`` — annotated with ``NamedSharding`` and left
+to XLA to turn into ``all-reduce`` / ``all-gather`` / ``reduce-scatter``
+ops over the ICI (the rebuild of the capability slot occupied by the fork's
+NCCL command stream, SURVEY.md §2.4).
+
+Size presets: ``tiny`` (tests/CI), ``1b``, ``7b`` (the Llama-2-7B target:
+dim 4096, 32 layers, 32 heads, ffn 11008, vocab 32000).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpusim.models.registry import register
+
+__all__ = ["LlamaConfig", "PRESETS", "init_llama", "llama_forward",
+           "make_llama_train_step", "build_llama_sharded"]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    dim: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 32
+    ffn: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(vocab=512, dim=128, layers=2, heads=4, kv_heads=4,
+                        ffn=352, max_seq=256),
+    "1b": LlamaConfig(vocab=32000, dim=2048, layers=16, heads=16,
+                      kv_heads=16, ffn=5504, max_seq=2048),
+    "7b": LlamaConfig(),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_llama(key, cfg: LlamaConfig):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(cfg.dtype)
+
+    def norm_init(k, shape, scale):
+        return jax.random.normal(k, shape, dt) * scale
+
+    params: dict = {}
+    key, k = jax.random.split(key)
+    params["embed"] = norm_init(k, (cfg.vocab, cfg.dim), 0.02)
+    params["final_norm"] = jnp.ones((cfg.dim,), dt)
+    layers = []
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    for _ in range(cfg.layers):
+        key, kq, kk, kv, ko, k1, k2, k3 = jax.random.split(key, 8)
+        layers.append({
+            "attn_norm": jnp.ones((cfg.dim,), dt),
+            "wq": norm_init(kq, (cfg.dim, cfg.dim), 0.02),
+            "wk": norm_init(kk, (cfg.dim, kv_dim), 0.02),
+            "wv": norm_init(kv, (cfg.dim, kv_dim), 0.02),
+            "wo": norm_init(ko, (cfg.dim, cfg.dim), 0.02),
+            "mlp_norm": jnp.ones((cfg.dim,), dt),
+            "w_gate": norm_init(k1, (cfg.dim, cfg.ffn), 0.02),
+            "w_up": norm_init(k2, (cfg.dim, cfg.ffn), 0.02),
+            "w_down": norm_init(k3, (cfg.ffn, cfg.dim), 0.02),
+        })
+    params["layers"] = layers
+    return params
+
+
+def param_shardings(cfg: LlamaConfig, mesh):
+    """Megatron-style NamedShardings over a ('dp','tp') mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "attn_norm": ns(),
+        "wq": ns(None, "tp"),     # column-parallel
+        "wk": ns(None, "tp"),
+        "wv": ns(None, "tp"),
+        "wo": ns("tp", None),     # row-parallel
+        "mlp_norm": ns(),
+        "w_gate": ns(None, "tp"),
+        "w_up": ns(None, "tp"),
+        "w_down": ns("tp", None),
+    }
+    return {
+        "embed": ns("tp", None),  # vocab-sharded embedding
+        "final_norm": ns(),
+        "layers": [dict(layer) for _ in range(cfg.layers)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _rmsnorm(x, w, eps):
+    import jax
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(x.dtype) * w
+
+
+def _rope(q, k, theta):
+    """Rotary embeddings over the last dim of q,k: [B,S,H,D]."""
+    import jax.numpy as jnp
+
+    seq = q.shape[1]
+    d = q.shape[-1]
+    pos = jnp.arange(seq, dtype=jnp.float32)
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = pos[:, None] * freqs[None, :]           # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _attention(x, layer, cfg: LlamaConfig):
+    import jax
+    import jax.numpy as jnp
+
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    q = (x @ layer["wq"]).reshape(b, s, cfg.heads, hd)
+    k = (x @ layer["wk"]).reshape(b, s, cfg.kv_heads, hd)
+    v = (x @ layer["wv"]).reshape(b, s, cfg.kv_heads, hd)
+    q, k = _rope(q, k, cfg.rope_theta)
+    if cfg.kv_heads != cfg.heads:  # GQA: repeat kv heads
+        rep = cfg.heads // cfg.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, cfg.dim)
+    return out @ layer["wo"]
+
+
+def _mlp(x, layer):
+    import jax
+
+    gate = jax.nn.silu(x @ layer["w_gate"])
+    return (gate * (x @ layer["w_up"])) @ layer["w_down"]
+
+
+def llama_forward(params, tokens, cfg: LlamaConfig):
+    """tokens [B,S] int32 → logits [B,S,vocab]."""
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = x + _attention(_rmsnorm(x, layer["attn_norm"], cfg.eps), layer, cfg)
+        x = x + _mlp(_rmsnorm(x, layer["mlp_norm"], cfg.eps), layer)
+    x = _rmsnorm(x, params["final_norm"], cfg.eps)
+    return x @ params["embed"].T
+
+
+def make_llama_train_step(cfg: LlamaConfig, lr: float = 3e-4):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, tokens, targets):
+        logits = llama_forward(params, tokens, cfg).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return nll.mean()
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree_util.tree_map(
+            lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads,
+        )
+        return loss, params
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Sharded builders
+# ---------------------------------------------------------------------------
+
+
+def build_llama_sharded(
+    preset: str = "tiny",
+    batch: int = 8,
+    seq: int | None = None,
+    dp: int = 1,
+    tp: int = 1,
+    train: bool = True,
+):
+    """Build a (step_fn, args) pair laid out over a dp×tp mesh.  Uses the
+    first ``dp*tp`` visible jax devices."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = PRESETS[preset]
+    seq = seq or min(cfg.max_seq, 512)
+    params = init_llama(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab, (batch, seq)),
+        jnp.int32,
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    n = dp * tp
+    if n > 1:
+        devs = np.array(jax.devices()[:n]).reshape(dp, tp)
+        mesh = Mesh(devs, ("dp", "tp"))
+        params = jax.device_put(params, param_shardings(cfg, mesh))
+        data_sh = NamedSharding(mesh, P("dp"))
+        tokens = jax.device_put(tokens, data_sh)
+        targets = jax.device_put(targets, data_sh)
+
+    if train:
+        return make_llama_train_step(cfg), (params, tokens, targets)
+
+    def fwd(params, tokens):
+        return llama_forward(params, tokens, cfg)
+
+    return fwd, (params, tokens)
+
+
+@register(
+    "llama_tiny",
+    description="tiny Llama decoder fwd (tests/CI)",
+    suite="models",
+    preset="tiny", batch=4, train=False,
+)
+def build_llama_tiny(**kw):
+    return build_llama_sharded(**kw)
+
+
+@register(
+    "llama_tiny_tp2dp2",
+    description="tiny Llama train step on a 2x2 dp/tp mesh",
+    suite="models",
+    num_devices=4,
+    preset="tiny", batch=8, dp=2, tp=2, train=True,
+)
+def build_llama_tiny_sharded(**kw):
+    return build_llama_sharded(**kw)
+
+
+@register(
+    "llama7b",
+    description="Llama-2-7B fwd, single chip (memory permitting)",
+    suite="models",
+    preset="7b", batch=1, seq=2048, train=False,
+)
+def build_llama7b(**kw):
+    return build_llama_sharded(**kw)
+
+
+@register(
+    "llama7b_tp8dp8",
+    description="Llama-2-7B pjit train step on dp8 x tp8 (v5p-64, "
+    "BASELINE config #5)",
+    suite="models",
+    num_devices=64,
+    preset="7b", batch=64, seq=2048, dp=8, tp=8, train=True,
+)
+def build_llama7b_sharded(**kw):
+    return build_llama_sharded(**kw)

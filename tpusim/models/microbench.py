@@ -1,0 +1,179 @@
+"""Microbenchmark workloads — the ``util/tuner/GPU_Microbenchmark`` ubench
+equivalents, JAX-native: shapes that isolate one unit (MXU matmul/conv, VPU
+elementwise, HBM streams, transcendentals) for tuner fitting and the
+single-chip MXU baseline (BASELINE.json config #3)."""
+
+from __future__ import annotations
+
+from tpusim.models.registry import register
+
+__all__ = []
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@register(
+    "matmul",
+    description="single large bf16 matmul (MXU peak)",
+    suite="ubench",
+    m=4096, n=4096, k=4096, dtype="bfloat16",
+)
+def build_matmul(m: int, n: int, k: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return a @ b
+
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (m, k), jnp.dtype(dtype))
+    b = jax.random.normal(kb, (k, n), jnp.dtype(dtype))
+    return f, (a, b)
+
+
+@register(
+    "matmul_chain",
+    description="chain of matmuls with elementwise epilogues (fusion cost)",
+    suite="ubench",
+    m=2048, k=2048, depth=4, dtype="bfloat16",
+)
+def build_matmul_chain(m: int, k: int, depth: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, ws):
+        for w in ws:
+            x = jax.nn.gelu(x @ w)
+        return x
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, k), jnp.dtype(dtype))
+    ws = [
+        jax.random.normal(jax.random.PRNGKey(i + 1), (k, k), jnp.dtype(dtype))
+        for i in range(depth)
+    ]
+    return f, (x, ws)
+
+
+@register(
+    "conv2d",
+    description="ResNet-ish 3x3 convolution (MXU via implicit matmul)",
+    suite="ubench",
+    batch=32, hw=56, cin=128, cout=128, ksize=3, dtype="bfloat16",
+)
+def build_conv2d(batch: int, hw: int, cin: int, cout: int, ksize: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (batch, hw, hw, cin), jnp.dtype(dtype))
+    w = jax.random.normal(
+        jax.random.PRNGKey(1), (ksize, ksize, cin, cout), jnp.dtype(dtype)
+    )
+    return f, (x, w)
+
+
+@register(
+    "elementwise_stream",
+    description="HBM-bound elementwise op over a large buffer",
+    suite="ubench",
+    elems=64 * 1024 * 1024, dtype="float32",
+)
+def build_elementwise(elems: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x * 1.5 + 2.0
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (elems,), jnp.dtype(dtype))
+    return f, (x,)
+
+
+@register(
+    "transcendental",
+    description="VPU transcendental throughput (exp/tanh mix)",
+    suite="ubench",
+    elems=8 * 1024 * 1024, dtype="float32",
+)
+def build_transcendental(elems: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.tanh(jnp.exp(x * 0.1))
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (elems,), jnp.dtype(dtype))
+    return f, (x,)
+
+
+@register(
+    "reduction",
+    description="large reduction (VPU + HBM)",
+    suite="ubench",
+    rows=8192, cols=8192, dtype="float32",
+)
+def build_reduction(rows: int, cols: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x.sum(axis=1)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.dtype(dtype))
+    return f, (x,)
+
+
+@register(
+    "mlp_train_step",
+    description="small MLP forward+backward+SGD (single chip end-to-end)",
+    suite="ubench",
+    batch=512, width=2048, depth=3, dtype="bfloat16", lr=1e-2,
+)
+def build_mlp_train(batch: int, width: int, depth: int, dtype: str, lr: float):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        h = x
+        for w, b in params[:-1]:
+            h = jax.nn.relu(h @ w + b)
+        w, b = params[-1]
+        logits = h @ w + b
+        return jnp.mean((logits - y) ** 2)
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads
+        )
+        return loss, new_params
+
+    dt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    params = []
+    for i in range(depth):
+        kw, kb, key = jax.random.split(key, 3)
+        params.append((
+            jax.random.normal(kw, (width, width), dt) * (1.0 / width ** 0.5),
+            jax.random.normal(kb, (width,), dt) * 0.0,
+        ))
+    x = jax.random.normal(key, (batch, width), dt)
+    # a learnable target: a fixed random linear map of x (so the loss is
+    # reducible — this workload doubles as a training self-check)
+    target_map = jax.random.normal(
+        jax.random.PRNGKey(9), (width, width), dt
+    ) * (1.0 / width ** 0.5)
+    y = x @ target_map
+    return step, (params, x, y)

@@ -1,0 +1,217 @@
+"""ResNet-50 training workload (BASELINE.json config #4: data-parallel over a
+v5p-8 mesh).
+
+Hand-rolled in pure JAX (no flax dependency in the capture path) so the
+traced HLO is exactly what we construct: conv stem, four bottleneck stages
+[3,4,6,3], batch-norm in training mode, SGD-momentum step.  Data parallelism
+is expressed TPU-natively: a ``jax.sharding.Mesh`` with the batch sharded
+over the ``dp`` axis — XLA GSPMD then inserts the gradient ``all-reduce``
+ops that the ICI model times (the rebuild of the fork's traced
+``ncclAllReduce`` path, ``tracer_tool.cu:782-859``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from tpusim.models.registry import register
+
+__all__ = ["init_resnet50", "resnet50_apply", "make_train_step"]
+
+STAGE_BLOCKS = (3, 4, 6, 3)
+STAGE_FILTERS = (64, 128, 256, 512)
+EXPANSION = 4
+
+
+def _conv(x, w, stride=1):
+    import jax
+
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn_train(x, scale, bias, eps=1e-5):
+    import jax
+    import jax.numpy as jnp
+
+    mean = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    inv = scale * jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    return (x - mean) * inv + bias
+
+
+def _he(key, shape, dtype):
+    import jax
+    import jax.numpy as jnp
+    import math
+
+    fan_in = math.prod(shape[:-1])
+    return jax.random.normal(key, shape, dtype) * (2.0 / fan_in) ** 0.5
+
+
+def init_resnet50(key, num_classes=1000, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    params = {}
+    key, k = jax.random.split(key)
+    params["stem_conv"] = _he(k, (7, 7, 3, 64), dt)
+    params["stem_scale"] = jnp.ones((64,), dt)
+    params["stem_bias"] = jnp.zeros((64,), dt)
+
+    cin = 64
+    for stage, (blocks, filters) in enumerate(zip(STAGE_BLOCKS, STAGE_FILTERS)):
+        cout = filters * EXPANSION
+        for block in range(blocks):
+            prefix = f"s{stage}b{block}"
+            key, k1, k2, k3, k4 = jax.random.split(key, 5)
+            params[f"{prefix}_c1"] = _he(k1, (1, 1, cin, filters), dt)
+            params[f"{prefix}_c2"] = _he(k2, (3, 3, filters, filters), dt)
+            params[f"{prefix}_c3"] = _he(k3, (1, 1, filters, cout), dt)
+            for i in (1, 2, 3):
+                ch = filters if i < 3 else cout
+                params[f"{prefix}_scale{i}"] = jnp.ones((ch,), dt)
+                params[f"{prefix}_bias{i}"] = jnp.zeros((ch,), dt)
+            if block == 0:
+                params[f"{prefix}_proj"] = _he(k4, (1, 1, cin, cout), dt)
+                params[f"{prefix}_proj_scale"] = jnp.ones((cout,), dt)
+                params[f"{prefix}_proj_bias"] = jnp.zeros((cout,), dt)
+            cin = cout
+
+    key, k = jax.random.split(key)
+    params["head_w"] = _he(k, (cin, num_classes), dt)
+    params["head_b"] = jnp.zeros((num_classes,), dt)
+    return params
+
+
+def resnet50_apply(params, x):
+    import jax
+    import jax.numpy as jnp
+
+    h = _conv(x, params["stem_conv"], stride=2)
+    h = _bn_train(h, params["stem_scale"], params["stem_bias"])
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(
+        h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+
+    cin = 64
+    for stage, (blocks, filters) in enumerate(zip(STAGE_BLOCKS, STAGE_FILTERS)):
+        cout = filters * EXPANSION
+        for block in range(blocks):
+            prefix = f"s{stage}b{block}"
+            stride = 2 if (block == 0 and stage > 0) else 1
+            shortcut = h
+            if block == 0:
+                shortcut = _conv(h, params[f"{prefix}_proj"], stride=stride)
+                shortcut = _bn_train(
+                    shortcut, params[f"{prefix}_proj_scale"],
+                    params[f"{prefix}_proj_bias"],
+                )
+            y = _conv(h, params[f"{prefix}_c1"])
+            y = jax.nn.relu(_bn_train(
+                y, params[f"{prefix}_scale1"], params[f"{prefix}_bias1"]))
+            y = _conv(y, params[f"{prefix}_c2"], stride=stride)
+            y = jax.nn.relu(_bn_train(
+                y, params[f"{prefix}_scale2"], params[f"{prefix}_bias2"]))
+            y = _conv(y, params[f"{prefix}_c3"])
+            y = _bn_train(
+                y, params[f"{prefix}_scale3"], params[f"{prefix}_bias3"])
+            h = jax.nn.relu(y + shortcut)
+            cin = cout
+
+    h = h.mean(axis=(1, 2))
+    return h @ params["head_w"] + params["head_b"]
+
+
+def make_train_step(momentum=0.9, lr=0.1):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, labels):
+        logits = resnet50_apply(params, x).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+        return loss
+
+    def step(params, velocity, x, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, labels)
+        velocity = jax.tree_util.tree_map(
+            lambda v, g: momentum * v + g, velocity, grads
+        )
+        params = jax.tree_util.tree_map(
+            lambda p, v: p - lr * v.astype(p.dtype), params, velocity
+        )
+        return loss, params, velocity
+
+    return step
+
+
+def _build(batch, image, num_classes, dtype, num_devices, train):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    params = init_resnet50(jax.random.PRNGKey(0), num_classes, dtype)
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), (batch, image, image, 3), jnp.dtype(dtype)
+    )
+    labels = jnp.asarray(
+        np.arange(batch) % num_classes, jnp.int32
+    )
+
+    if num_devices > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:num_devices])
+        mesh = Mesh(devs, ("dp",))
+        xsh = NamedSharding(mesh, P("dp"))
+        repl = NamedSharding(mesh, P())
+        x = jax.device_put(x, xsh)
+        labels = jax.device_put(labels, xsh)
+        params = jax.device_put(params, repl)
+
+    if not train:
+        return resnet50_apply, (params, x)
+
+    step = make_train_step()
+    velocity = jax.tree_util.tree_map(lambda p: p * 0, params)
+    return step, (params, velocity, x, labels)
+
+
+@register(
+    "resnet50",
+    description="ResNet-50 fwd (single chip)",
+    suite="models",
+    batch=32, image=224, num_classes=1000, dtype="bfloat16",
+    num_devices=1, train=False,
+)
+def build_resnet50(**kw):
+    return _build(**kw)
+
+
+@register(
+    "resnet50_train",
+    description="ResNet-50 train step (single chip)",
+    suite="models",
+    batch=32, image=224, num_classes=1000, dtype="bfloat16",
+    num_devices=1, train=True,
+)
+def build_resnet50_train(**kw):
+    return _build(**kw)
+
+
+@register(
+    "resnet50_dp8",
+    description="ResNet-50 train step, data-parallel over 8 chips "
+    "(BASELINE config #4)",
+    suite="models",
+    num_devices=8,
+    batch=256, image=224, num_classes=1000, dtype="bfloat16", train=True,
+)
+def build_resnet50_dp8(**kw):
+    kw.setdefault("num_devices", 8)
+    return _build(**kw)

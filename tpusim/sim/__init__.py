@@ -1,0 +1,16 @@
+"""Simulation driver layer: command-stream replay + stats reporting.
+
+The rebuild of ``gpu-simulator/main.cc`` (trace-driven driver) and the stats
+printing of ``gpgpu_sim::print_stats`` / ``gpgpusim_entrypoint.cc``.
+"""
+
+from tpusim.sim.driver import SimDriver, SimReport, simulate_trace
+from tpusim.sim.stats import StatsRegistry, EXIT_SENTINEL
+
+__all__ = [
+    "SimDriver",
+    "SimReport",
+    "simulate_trace",
+    "StatsRegistry",
+    "EXIT_SENTINEL",
+]

@@ -1,0 +1,237 @@
+"""Trace-replay driver.
+
+The rebuild of ``gpu-simulator/main.cc``: parse the command list, maintain
+per-stream ordering with cross-stream overlap (the busy-stream gating of
+``main.cc:102-115``), model memcpys (``-gpgpu_perf_sim_memcpy`` →
+``perf_memcpy_to_gpu``, ``gpu-sim.cc:2116``), launch kernels into the timing
+engine, and handle collective commands — which the fork handled as a constant
+latency (``main.cc:116-134``) and we hand to the ICI model with real sizes,
+groups, and cross-device rendezvous.
+
+Per-device resources: the TensorCore (kernels serialize on it), the host DMA
+channel (memcpys), and the ICI port (standalone collectives).  Commands on
+one stream execute in order; different streams overlap on different
+resources — the same semantics as the reference's stream windowing.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.topology import Topology, torus_for
+from tpusim.ir import CommandKind, PodTrace, TraceCommand
+from tpusim.sim.stats import EXIT_SENTINEL, StatsRegistry
+from tpusim.timing.config import SimConfig
+from tpusim.timing.engine import Engine, EngineResult
+
+__all__ = ["SimDriver", "SimReport", "simulate_trace"]
+
+
+@dataclass
+class KernelRecord:
+    module: str
+    device_id: int
+    stream_id: int
+    start_cycle: float
+    end_cycle: float
+    result: EngineResult
+
+
+@dataclass
+class SimReport:
+    """Result of replaying one pod trace."""
+
+    config_name: str
+    num_devices: int
+    device_cycles: dict[int, float] = field(default_factory=dict)
+    kernels: list[KernelRecord] = field(default_factory=list)
+    totals: EngineResult = field(default_factory=EngineResult)
+    memcpy_cycles: float = 0.0
+    collective_cmd_cycles: float = 0.0
+    wall_seconds: float = 0.0       # host time spent simulating
+    stats: StatsRegistry = field(default_factory=StatsRegistry)
+
+    @property
+    def cycles(self) -> float:
+        return max(self.device_cycles.values(), default=0.0)
+
+    @property
+    def sim_rate_kops(self) -> float:
+        """Simulated HLO ops per host-second, in K — the
+        ``gpgpu_simulation_rate`` analogue (KIPS in BASELINE.md)."""
+        if self.wall_seconds <= 0:
+            return 0.0
+        return self.totals.op_count / self.wall_seconds / 1e3
+
+    def finalize(self, arch_clock_hz: float) -> None:
+        # totals accumulates per-kernel counters; its wall-clock view is the
+        # pod's critical path, needed for the derived utilization stats
+        self.totals.cycles = self.cycles
+        self.totals.seconds = self.cycles / arch_clock_hz
+        s = self.stats
+        s.set("num_devices", self.num_devices)
+        s.set("sim_cycle", self.cycles)
+        s.set("sim_elapsed_s", self.cycles / arch_clock_hz)
+        s.set("kernel_launches", len(self.kernels))
+        s.set("memcpy_cycles", self.memcpy_cycles)
+        s.set("collective_cmd_cycles", self.collective_cmd_cycles)
+        s.set("simulation_rate_kops", self.sim_rate_kops)
+        s.update(self.totals.stats_dict(), prefix="tot_")
+
+    def print_report(self, out=None) -> None:
+        import sys
+
+        out = out or sys.stdout
+        self.stats.print_text(out)
+        print(EXIT_SENTINEL, file=out)
+
+
+class SimDriver:
+    """Replays a :class:`PodTrace` under a :class:`SimConfig`."""
+
+    def __init__(self, config: SimConfig, topology: Topology | None = None):
+        self.config = config
+        self.arch = config.arch
+        self.topology = topology
+
+    # ------------------------------------------------------------------
+
+    def run(self, pod: PodTrace) -> SimReport:
+        t_start = time.perf_counter()
+        cfg = self.config
+        arch = self.arch
+
+        n_devices = max(
+            (int(pod.meta.get("num_devices", 0) or 0)),
+            max((m.num_devices for m in pod.modules.values()), default=1),
+            len(pod.devices) or 1,
+        )
+        topo = self.topology or torus_for(n_devices, arch.name)
+        coll = CollectiveModel(topo, arch.ici)
+        engine = Engine(cfg, topology=topo)
+
+        report = SimReport(config_name=arch.name, num_devices=n_devices)
+
+        # Kernel timing is per-module (SPMD: all devices run the same
+        # program) — cache engine results like the reference caches parsed
+        # kernel traces per launch (trace_driven.cc:540-586).
+        module_results: dict[str, EngineResult] = {}
+
+        def module_result(name: str) -> EngineResult:
+            if name not in module_results:
+                if name not in pod.modules:
+                    raise KeyError(
+                        f"command references unknown module {name!r}; "
+                        f"trace has {sorted(pod.modules)}"
+                    )
+                module_results[name] = engine.run(pod.modules[name])
+            return module_results[name]
+
+        # Cross-device collective rendezvous: k-th standalone collective on
+        # each participating device must align (NCCL call-order matching).
+        coll_ready: dict[int, list[float]] = defaultdict(list)
+
+        device_ids = sorted(pod.devices) or [0]
+        # per-device resource timelines
+        core_free = {d: 0.0 for d in device_ids}
+        dma_free = {d: 0.0 for d in device_ids}
+        ici_free = {d: 0.0 for d in device_ids}
+        stream_free: dict[tuple[int, int], float] = defaultdict(float)
+
+        for dev_id in device_ids:
+            dev = pod.devices.get(dev_id)
+            if dev is None:
+                continue
+            coll_index = 0
+            for cmd in dev.commands:
+                key = (dev_id, cmd.stream_id)
+                ready = stream_free[key]
+
+                if cmd.kind == CommandKind.KERNEL_LAUNCH:
+                    res = module_result(cmd.module)
+                    start = max(ready, core_free[dev_id])
+                    dur = res.cycles
+                    end = start + dur
+                    core_free[dev_id] = end
+                    stream_free[key] = end
+                    report.kernels.append(KernelRecord(
+                        cmd.module, dev_id, cmd.stream_id, start, end, res
+                    ))
+                    report.totals.merge_scaled(res, 1.0)
+
+                elif cmd.kind in (CommandKind.MEMCPY_H2D, CommandKind.MEMCPY_D2H):
+                    if cfg.perf_sim_memcpy and cmd.nbytes > 0:
+                        secs = arch.host_latency + cmd.nbytes / arch.host_bandwidth
+                        dur = arch.seconds_to_cycles(secs)
+                    else:
+                        dur = 0.0
+                    start = max(ready, dma_free[dev_id])
+                    end = start + dur
+                    dma_free[dev_id] = end
+                    stream_free[key] = end
+                    report.memcpy_cycles += dur
+
+                elif cmd.kind == CommandKind.COLLECTIVE and cmd.collective:
+                    secs = coll.seconds(cmd.collective, float(cmd.nbytes))
+                    dur = arch.seconds_to_cycles(secs)
+                    start = max(ready, ici_free[dev_id])
+                    # rendezvous with peers' k-th collective: all
+                    # participants start together at the latest arrival
+                    peers = coll_ready[coll_index]
+                    if peers:
+                        start = max(start, max(peers))
+                    coll_ready[coll_index].append(start)
+                    coll_index += 1
+                    end = start + dur
+                    ici_free[dev_id] = end
+                    stream_free[key] = end
+                    report.collective_cmd_cycles += dur
+                    report.totals.collective_count += 1
+                    report.totals.ici_bytes += cmd.nbytes
+                    report.totals.collective_cycles += dur
+
+                else:
+                    # comm_init/destroy/group markers: logged no-ops, like
+                    # the reference (main.cc:125-133)
+                    stream_free[key] = ready
+
+            report.device_cycles[dev_id] = max(
+                core_free[dev_id], dma_free[dev_id], ici_free[dev_id],
+                max((v for (d, _), v in stream_free.items() if d == dev_id),
+                    default=0.0),
+            )
+
+        report.wall_seconds = time.perf_counter() - t_start
+        report.finalize(arch.clock_hz)
+        return report
+
+
+def simulate_trace(
+    trace_path: str | Path,
+    config: SimConfig | None = None,
+    arch: str | None = None,
+    overlays: list[Any] | None = None,
+) -> SimReport:
+    """One-call CLI-style entry: load a trace dir, pick a config, replay.
+
+    The ``accel-sim.out -trace ... -config ...`` equivalent
+    (``main.cc:55-206``)."""
+    from tpusim.timing.config import load_config
+    from tpusim.trace.format import load_trace
+
+    cfg = load_config(config, arch=arch, overlays=overlays)
+    pod = load_trace(trace_path)
+    if arch is None and config is None:
+        # default the arch to the one the trace was captured on
+        kind = str(pod.meta.get("device_kind", ""))
+        if kind:
+            from tpusim.timing.arch import detect_arch
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, arch=detect_arch(kind))
+    return SimDriver(cfg).run(pod)

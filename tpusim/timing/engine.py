@@ -184,9 +184,14 @@ class Engine:
             # ---- control flow: recurse ---------------------------------
             if base == "while" and len(op.called) >= 1:
                 body_name = op.attrs.get("body", "").lstrip("%") or op.called[0]
-                trips = while_trip_count(
-                    op, self.config.default_loop_trip_count
-                )
+                trips = while_trip_count(op, 0)
+                if trips <= 0:  # no backend_config: infer from the IV pattern
+                    from tpusim.trace.loop_analysis import infer_trip_count
+
+                    trips = infer_trip_count(
+                        module, comp, op,
+                        self.config.default_loop_trip_count,
+                    )
                 sub = EngineResult()
                 body_end = self._run_computation(
                     module, module.computation(body_name), 0.0, coll, sub,

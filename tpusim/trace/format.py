@@ -163,6 +163,13 @@ def save_trace(
 def load_trace(path: str | Path) -> PodTrace:
     """Load a trace directory into a :class:`PodTrace` (modules parsed)."""
     path = Path(path)
+    if not path.is_dir():
+        raise FileNotFoundError(f"trace directory not found: {path}")
+    if not (path / "modules").is_dir() and not (path / "commandlist.jsonl").exists():
+        raise FileNotFoundError(
+            f"{path} is not a trace directory (no modules/ or "
+            f"commandlist.jsonl)"
+        )
     meta_path = path / "meta.json"
     meta: dict = {}
     if meta_path.exists():

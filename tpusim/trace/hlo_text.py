@@ -112,6 +112,7 @@ _SHAPE_RE = re.compile(
     r"$"
 )
 
+_COMMENT_RE = re.compile(r"/\*.*?\*/")
 _TILING_RE = re.compile(r"T(\([0-9,]*\))+")
 _SPACE_RE = re.compile(r"S\((\d+)\)")
 
@@ -119,7 +120,7 @@ _SPACE_RE = re.compile(r"S\((\d+)\)")
 def parse_shape(text: str) -> TensorSpec | TupleSpec:
     """Parse one HLO shape string, e.g. ``bf16[256,512]{1,0:T(8,128)(2,1)}``
     or a tuple ``(f32[8]{0}, u32[])``."""
-    text = text.strip()
+    text = _COMMENT_RE.sub("", text).strip()
     if text.startswith("("):
         end = _find_matching(text, 0)
         inner = text[1:end]
@@ -379,6 +380,10 @@ def parse_instruction(line: str) -> TraceOp | None:
 
     from tpusim.ir import base_opcode
 
+    if opcode == "constant":
+        # preserve the literal so loop analysis can resolve scalar bounds
+        attrs.setdefault("literal", operand_str.strip())
+
     op = TraceOp(
         name=m.group("name"),
         opcode=opcode,
@@ -418,12 +423,23 @@ def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
     """
     module = ModuleTrace(name=name_hint)
     current: Computation | None = None
-    in_tail_tables = False
 
     for raw in text.splitlines():
         line = raw.rstrip()
         stripped = line.strip()
         if not stripped:
+            continue
+
+        # Auxiliary tables XLA interleaves into dumps (FileNames,
+        # FunctionNames, FileLocations, StackFrames): a section-name line
+        # followed by numbered entries.  Skip both forms outside
+        # computation bodies.
+        if current is None and (
+            stripped in (
+                "FileNames", "FunctionNames", "FileLocations", "StackFrames",
+            )
+            or stripped[0].isdigit()
+        ):
             continue
 
         mm = _MODULE_RE.match(stripped)
@@ -442,15 +458,6 @@ def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
                         pass
                 elif key == "is_scheduled":
                     module.meta[key] = val == "true"
-            continue
-
-        # Tail tables from newer XLA dumps ("FileNames", "FileLocations", ...)
-        if current is None and stripped in (
-            "FileNames", "FunctionNames", "FileLocations", "StackFrames",
-        ):
-            in_tail_tables = True
-            continue
-        if in_tail_tables and current is None:
             continue
 
         ch = _COMP_HEADER_RE.match(stripped)

@@ -1,0 +1,16 @@
+"""Live-capture frontend: JAX workloads → stored traces.
+
+The rebuild of the reference's tracer stack (``util/tracer_nvbit/``): where
+that LD_PRELOADs an NVBit tool to instrument every SASS instruction on a real
+GPU (``tracer_tool.cu``) and post-processes raw records into ``.traceg``
+files, we ask XLA for the artifact it already has — the scheduled, optimized
+HLO of a compiled executable — plus its cost analysis and (optionally) real
+execution timings for correlation.  No binary instrumentation is needed;
+``jit → lower → compile`` is the capture point, and it works identically on
+a TPU-VM or a CPU host (the CPU path is this framework's "trace download"
+substitute for fixtures, cf. ``get-accel-sim-traces.py``).
+"""
+
+from tpusim.tracer.capture import Capture, capture, capture_to_dir, measure_wall_time
+
+__all__ = ["Capture", "capture", "capture_to_dir", "measure_wall_time"]

@@ -1,0 +1,264 @@
+"""JAX → trace capture.
+
+Capture pipeline (mirror of ``tracer_tool.cu`` + ``post-traces-processing``):
+
+1. ``jax.jit(fn).lower(*args)`` — tracing (the instrumentation point; this is
+   where NVBit would inject callbacks, ``tracer_tool.cu:130-275``).
+2. ``.compile()`` — XLA optimizes + schedules; ``compiled.as_text()`` is the
+   per-device program the hardware runs, with layouts, fusions and async
+   collective pairs.  This text is the trace body (the ``.traceg``).
+3. ``compiled.cost_analysis()`` — XLA's own flops/bytes accounting, stored in
+   the trace meta as ground truth for the cost model's unit tests.
+4. (optional, on real hardware) timed execution — the correlation target,
+   standing in for ``util/hw_stats/run_hw.py``'s nvprof pass.
+
+The capture honors ``TPUSIM_TRACE_DEVICE`` the way the fork's tracer honors
+``GPU_TRACE_ID`` (``tracer_tool.cu:115-116,303-316``): in a multi-device
+process, trace only that device's view (SPMD programs are identical across
+devices, so one program + the topology is the whole pod trace).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+from tpusim.ir import CommandKind, ModuleTrace, TraceCommand
+from tpusim.trace.format import TraceDir, save_trace
+from tpusim.trace.hlo_text import parse_hlo_module
+
+__all__ = ["Capture", "capture", "capture_to_dir", "measure_wall_time"]
+
+
+def _tree_bytes(tree: Any) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * leaf.dtype.itemsize
+    return total
+
+
+@dataclass
+class Capture:
+    """One captured module + its metadata; convertible to IR or disk."""
+
+    name: str
+    hlo_text: str
+    meta: dict[str, Any] = field(default_factory=dict)
+    in_bytes: int = 0
+    out_bytes: int = 0
+
+    _module: ModuleTrace | None = field(default=None, repr=False)
+
+    @property
+    def module(self) -> ModuleTrace:
+        if self._module is None:
+            self._module = parse_hlo_module(self.hlo_text, name_hint=self.name)
+            self._module.meta.update(self.meta)
+        return self._module
+
+    def commands(self, device_id: int = 0, stream_id: int = 0) -> list[TraceCommand]:
+        """The command-stream entries for one launch of this capture:
+        H2D memcpys for inputs, the kernel launch, D2H for outputs —
+        the shape of a ``kernelslist.g`` entry set
+        (``trace_parser.cc:220-297``)."""
+        cmds = []
+        if self.in_bytes:
+            cmds.append(TraceCommand(
+                kind=CommandKind.MEMCPY_H2D, stream_id=stream_id,
+                device_id=device_id, nbytes=self.in_bytes,
+            ))
+        cmds.append(TraceCommand(
+            kind=CommandKind.KERNEL_LAUNCH, stream_id=stream_id,
+            device_id=device_id, module=self.name,
+        ))
+        if self.out_bytes:
+            cmds.append(TraceCommand(
+                kind=CommandKind.MEMCPY_D2H, stream_id=stream_id,
+                device_id=device_id, nbytes=self.out_bytes,
+            ))
+        return cmds
+
+
+def capture(
+    fn: Callable,
+    *args: Any,
+    name: str | None = None,
+    static_argnums: Sequence[int] = (),
+    donate_argnums: Sequence[int] = (),
+    jit_kwargs: dict[str, Any] | None = None,
+    include_memcpy: bool = True,
+    **kwargs: Any,
+) -> Capture:
+    """Capture ``fn(*args, **kwargs)`` as a trace.  ``fn`` may already be a
+    ``jax.jit``-wrapped function (it is not re-wrapped)."""
+    import jax
+
+    jit_kwargs = dict(jit_kwargs or {})
+    if static_argnums:
+        jit_kwargs["static_argnums"] = tuple(static_argnums)
+    if donate_argnums:
+        jit_kwargs["donate_argnums"] = tuple(donate_argnums)
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn, **jit_kwargs)
+    lowered = jitted.lower(*args, **kwargs)
+    compiled = lowered.compile()
+
+    hlo_text = compiled.as_text()
+    cost = {}
+    try:
+        raw = compiled.cost_analysis()
+        if isinstance(raw, (list, tuple)):
+            raw = raw[0] if raw else {}
+        cost = {k: float(v) for k, v in (raw or {}).items()
+                if isinstance(v, (int, float))}
+    except Exception:  # cost analysis is best-effort on some backends
+        pass
+
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                     "output_size_in_bytes", "generated_code_size_in_bytes"):
+            if hasattr(ma, attr):
+                mem[attr] = int(getattr(ma, attr))
+    except Exception:
+        pass
+
+    dev = jax.devices()[0]
+    trace_device = int(os.environ.get("TPUSIM_TRACE_DEVICE", "0") or 0)
+    cap_name = name or getattr(fn, "__name__", None) or "captured"
+    cap_name = cap_name.replace("<", "").replace(">", "")
+
+    meta: dict[str, Any] = {
+        "capture_name": cap_name,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "num_devices": jax.device_count(),
+        "trace_device": trace_device,
+        "xla_cost_analysis": cost,
+        "memory_analysis": mem,
+    }
+
+    in_bytes = _tree_bytes(args) + _tree_bytes(kwargs) if include_memcpy else 0
+    out_bytes = 0
+    if include_memcpy:
+        try:
+            out_shapes = lowered.out_info
+            out_bytes = sum(
+                int(getattr(s, "size", 0))
+                * getattr(getattr(s, "dtype", None), "itemsize", 0)
+                for s in jax.tree_util.tree_leaves(out_shapes)
+            )
+        except Exception:
+            out_bytes = 0
+
+    return Capture(
+        name=cap_name, hlo_text=hlo_text, meta=meta,
+        in_bytes=in_bytes, out_bytes=out_bytes,
+    )
+
+
+def capture_to_dir(
+    path: str | Path,
+    fn: Callable,
+    *args: Any,
+    name: str | None = None,
+    launches: int = 1,
+    **kwargs: Any,
+) -> TraceDir:
+    """Capture and write a trace directory (module + commandlist + meta) —
+    the end-to-end ``run_hw_trace.py`` equivalent for one workload."""
+    cap = capture(fn, *args, name=name, **kwargs)
+    cmds: list[TraceCommand] = []
+    for i in range(launches):
+        launch_cmds = cap.commands()
+        # steady-state shape: inputs uploaded once before the first launch,
+        # outputs read back once after the last; middles are kernel-only
+        if i > 0:
+            launch_cmds = [
+                c for c in launch_cmds if c.kind != CommandKind.MEMCPY_H2D
+            ]
+        if i < launches - 1:
+            launch_cmds = [
+                c for c in launch_cmds if c.kind != CommandKind.MEMCPY_D2H
+            ]
+        cmds.extend(launch_cmds)
+    return save_trace(
+        path, modules={cap.name: cap.hlo_text}, commands=cmds, meta=cap.meta
+    )
+
+
+def measure_wall_time(
+    fn: Callable,
+    *args: Any,
+    iters: int = 10,
+    warmup: int = 3,
+    **kwargs: Any,
+) -> dict[str, float]:
+    """Time real execution — the silicon truth for correlation, standing in
+    for nvprof ``Duration`` (``util/plotting/correl_mappings.py:24-100``).
+
+    Timing protocol: on tunneled/remote TPU backends ``block_until_ready``
+    can return before device compute finishes (observed on axon), so each
+    timed batch is fenced by a 1-element host readback of a reduction over
+    the last output — the only reliable sync.  The readback+reduction
+    overhead is measured separately on an already-computed buffer and
+    subtracted."""
+    import jax
+    import jax.numpy as jnp
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+
+    def _fence(out) -> float:
+        # reduce to one scalar and pull it to host: forces full execution
+        leaves = [l for l in jax.tree_util.tree_leaves(out)
+                  if hasattr(l, "dtype")]
+        acc = sum(jnp.sum(jnp.abs(l.astype(jnp.float32))) for l in leaves)
+        return float(acc)
+
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = jitted(*args, **kwargs)
+    _fence(out)
+
+    # fence overhead on a ready output (launches the small reduction again);
+    # take the min of a few samples — RPC jitter is large on tunnels
+    fence_samples = []
+    for _ in range(3):
+        f0 = time.perf_counter()
+        _fence(out)
+        fence_samples.append(time.perf_counter() - f0)
+    fence_s = min(fence_samples)
+
+    # size the timed batch so device compute dwarfs fence jitter
+    t0 = time.perf_counter()
+    out = jitted(*args, **kwargs)
+    _fence(out)
+    t_one = max(time.perf_counter() - t0 - fence_s, 1e-6)
+    target = max(10.0 * fence_s, 0.3)
+    batch = max(min(int(target / t_one) + 1, 10_000), max(iters, 1))
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(batch):
+            out = jitted(*args, **kwargs)
+        _fence(out)
+        dt = time.perf_counter() - t0
+        times.append(max(dt - fence_s, 1e-9) / batch)
+    times.sort()
+    return {
+        "iters": float(3 * batch),
+        "fence_s": fence_s,
+        "min_s": times[0],
+        "median_s": times[len(times) // 2],
+        "mean_s": sum(times) / len(times),
+    }
