@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Headline benchmark: sim-cycle accuracy vs silicon.
+
+Runs a small correlation suite on the local TPU chip — compute-bound,
+bandwidth-bound, and mixed workloads — comparing the timing engine's
+estimate of each captured HLO program against fenced wall-clock measurement
+of the same program on the device (the framework's whole point; north-star
+from BASELINE.md: <=15% cycle error).
+
+Prints ONE json line:
+  metric       "sim_cycle_error_pct"  (mean |error| across the suite)
+  value        mean absolute percent error, lower is better
+  unit         "%"
+  vs_baseline  value / 15.0  (the reference north-star bound; <1.0 beats it)
+
+Extra per-workload detail goes to stderr so stdout stays one line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+SUITE = [
+    # (workload name, build overrides, scan steps) — small programs get
+    # more steps so tunnel RPC jitter amortizes away
+    ("matmul_chain", {"m": 2048, "k": 2048, "depth": 4}, 16),   # MXU-bound
+    ("elementwise_stream", {"elems": 32 * 1024 * 1024}, 16),    # HBM-bound
+    ("reduction", {"rows": 4096, "cols": 4096}, 64),            # VPU+HBM
+    ("mlp_train_step", {"batch": 256, "width": 1024, "depth": 2}, 64),  # mixed
+]
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import jax
+
+    from tpusim.harness.correlate import correlate_workload
+    from tpusim.models import get_workload
+
+    dev = jax.devices()[0]
+    log(f"bench: device={dev.device_kind} platform={dev.platform}")
+
+    points = []
+    for name, overrides, n_steps in SUITE:
+        try:
+            fn, args = get_workload(name).build(**overrides)
+            pt = correlate_workload(
+                fn, args, name=name, n_steps=n_steps, iters=3
+            )
+            points.append(pt)
+            log(
+                f"bench: {name:24s} sim={pt.sim_seconds * 1e6:9.1f}us "
+                f"real={pt.real_seconds * 1e6:9.1f}us "
+                f"err={pt.error_pct:+7.2f}%"
+            )
+        except Exception as e:  # keep the suite alive; report what ran
+            log(f"bench: {name} FAILED: {type(e).__name__}: {e}")
+
+    if not points:
+        print(json.dumps({
+            "metric": "sim_cycle_error_pct", "value": None, "unit": "%",
+            "vs_baseline": None, "error": "no workloads completed",
+        }))
+        return 1
+
+    mean_abs = sum(p.abs_error_pct for p in points) / len(points)
+    out = {
+        "metric": "sim_cycle_error_pct",
+        "value": round(mean_abs, 3),
+        "unit": "%",
+        "vs_baseline": round(mean_abs / 15.0, 4),
+        "detail": {
+            p.name: {
+                "sim_us": round(p.sim_seconds * 1e6, 1),
+                "real_us": round(p.real_seconds * 1e6, 1),
+                "err_pct": round(p.error_pct, 2),
+            }
+            for p in points
+        },
+        "device": dev.device_kind,
+        "workloads": len(points),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+// Fast HLO-text scanner — the native core of the stored-trace parser.
+//
+// The reference's trace parser is C++ (gpu-simulator/trace-parser/
+// trace_parser.cc) and its per-line work (inst_trace_t::parse_from_string)
+// is the hot path of trace loading; ours is the same but for HLO text.
+// Llama-scale optimized HLO dumps run to tens of MB, and the pure-Python
+// regex parser in tpusim/trace/hlo_text.py spends most of its time on line
+// classification and balanced-delimiter splitting.  This scanner does that
+// structural pass in C++ and emits a flat record stream; Python rebuilds IR
+// objects from pre-split fields (tpusim/trace/native.py).
+//
+// Output format (returned as one malloc'd buffer, caller frees via
+// hlo_scan_free): records separated by RS (0x1e), fields by US (0x1f).
+//   M <US> module_name <US> raw_module_attrs
+//   C <US> comp_name <US> is_entry("0"/"1")
+//   I <US> name <US> is_root <US> shape_text <US> opcode <US>
+//        operands(comma-joined) <US> raw_attr_text <US> literal
+//   (literal = raw paren content, emitted for "constant" ops only)
+// Control chars cannot appear in HLO text, so no escaping is needed.
+//
+// Build: make -C native   (produces libtpusim_native.so)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Out {
+  std::string buf;
+  void field(const char* s, size_t n) {
+    buf.append(s, n);
+    buf.push_back('\x1f');
+  }
+  void field(const std::string& s) { field(s.data(), s.size()); }
+  void end_record() {
+    if (!buf.empty() && buf.back() == '\x1f') buf.back() = '\x1e';
+    else buf.push_back('\x1e');
+  }
+};
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  return p;
+}
+
+// Find the matching closer for the opener at p (p points at the opener).
+// Respects double-quoted strings with backslash escapes.
+const char* find_match(const char* p, const char* end) {
+  char open = *p, close;
+  switch (open) {
+    case '(': close = ')'; break;
+    case '{': close = '}'; break;
+    case '[': close = ']'; break;
+    default: return nullptr;
+  }
+  int depth = 0;
+  bool in_str = false;
+  for (; p < end; ++p) {
+    char c = *p;
+    if (in_str) {
+      if (c == '\\') { ++p; continue; }
+      if (c == '"') in_str = false;
+    } else if (c == '"') {
+      in_str = true;
+    } else if (c == open) {
+      ++depth;
+    } else if (c == close) {
+      if (--depth == 0) return p;
+    }
+  }
+  return nullptr;
+}
+
+bool is_ident_char(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == '.' || c == '-';
+}
+
+// Parse one instruction line: [ROOT] %name = shape opcode(operands), attrs
+// Returns false if the line is not an instruction.
+bool scan_instruction(const char* p, const char* end, Out& out) {
+  p = skip_ws(p, end);
+  bool root = false;
+  if (end - p > 5 && std::memcmp(p, "ROOT ", 5) == 0) {
+    root = true;
+    p = skip_ws(p + 5, end);
+  }
+  if (p < end && *p == '%') ++p;
+  const char* name_start = p;
+  while (p < end && is_ident_char(*p)) ++p;
+  if (p == name_start) return false;
+  const char* name_end = p;
+  p = skip_ws(p, end);
+  if (p >= end || *p != '=') return false;
+  p = skip_ws(p + 1, end);
+
+  // result shape: tuple "(...)" possibly followed by layout, or
+  // "dtype[...]{...}" — scan until we hit " opcode("
+  const char* shape_start = p;
+  if (*p == '(') {
+    const char* m = find_match(p, end);
+    if (!m) return false;
+    p = m + 1;
+  } else {
+    while (p < end && *p != ' ') ++p;
+  }
+  const char* shape_end = p;
+  p = skip_ws(p, end);
+
+  const char* opcode_start = p;
+  while (p < end && *p != '(' && *p != ' ') ++p;
+  const char* opcode_end = p;
+  if (p >= end || *p != '(') return false;
+  const char* close = find_match(p, end);
+  if (!close) return false;
+
+  // operands: collect %-prefixed identifiers at top level of the parens
+  std::string operands;
+  {
+    const char* q = p + 1;
+    int depth = 0;
+    bool in_str = false;
+    const char* last_pct = nullptr;
+    auto flush = [&](const char* upto) {
+      if (!last_pct) return;
+      const char* s = last_pct + 1;
+      const char* e = s;
+      while (e < upto && is_ident_char(*e)) ++e;
+      if (e > s) {
+        if (!operands.empty()) operands.push_back(',');
+        operands.append(s, e - s);
+      }
+      last_pct = nullptr;
+    };
+    for (; q < close; ++q) {
+      char c = *q;
+      if (in_str) {
+        if (c == '\\') { ++q; continue; }
+        if (c == '"') in_str = false;
+      } else if (c == '"') {
+        in_str = true;
+      } else if (c == '(' || c == '{' || c == '[') {
+        ++depth;
+      } else if (c == ')' || c == '}' || c == ']') {
+        --depth;
+      } else if (depth == 0 && c == '%') {
+        flush(q);           // a previous operand ends here
+        last_pct = q;
+      } else if (depth == 0 && c == ',') {
+        flush(q);
+      }
+    }
+    flush(close);
+  }
+
+  const char* attrs = close + 1;
+  attrs = skip_ws(attrs, end);
+  if (attrs < end && *attrs == ',') attrs = skip_ws(attrs + 1, end);
+
+  out.field("I", 1);
+  out.field(name_start, name_end - name_start);
+  out.field(root ? "1" : "0", 1);
+  out.field(shape_start, shape_end - shape_start);
+  out.field(opcode_start, opcode_end - opcode_start);
+  out.field(operands);
+  out.field(attrs, end - attrs);
+  const bool is_const =
+      (opcode_end - opcode_start == 8) &&
+      std::memcmp(opcode_start, "constant", 8) == 0;
+  if (is_const)
+    out.field(p + 1, close - p - 1);
+  else
+    out.field("", 0);
+  out.end_record();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scans the HLO text; returns a malloc'd record buffer (see header
+// comment) and stores its length in *out_len.  Caller must free with
+// hlo_scan_free.  Returns nullptr on allocation failure.
+char* hlo_scan(const char* text, uint64_t len, uint64_t* out_len) {
+  Out out;
+  out.buf.reserve(len / 2);
+  const char* p = text;
+  const char* end = text + len;
+  bool in_comp = false;
+
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    // rtrim CR (CRLF input) and trailing whitespace — the Python parser
+    // strips lines, so the native scanner must too
+    while (line_end > p && (*(line_end - 1) == '\r' ||
+                            *(line_end - 1) == ' ' ||
+                            *(line_end - 1) == '\t'))
+      --line_end;
+    const char* s = skip_ws(p, line_end);
+    size_t n = line_end - s;
+
+    if (n == 0) { p = (nl ? nl : end) + 1; continue; }
+
+    if (!in_comp) {
+      if (n > 10 && std::memcmp(s, "HloModule ", 10) == 0) {
+        const char* q = s + 10;
+        const char* name_start = q;
+        while (q < line_end && is_ident_char(*q)) ++q;
+        out.field("M", 1);
+        out.field(name_start, q - name_start);
+        const char* rest = skip_ws(q, line_end);
+        if (rest < line_end && *rest == ',') rest = skip_ws(rest + 1, line_end);
+        out.field(rest, line_end - rest);
+        out.end_record();
+      } else if (line_end > s && *(line_end - 1) == '{' &&
+                 memchr(s, '(', n) != nullptr &&
+                 // a computation header has "(params) -> ret {"; the '%'
+                 // prefix is optional (matches Python's _COMP_HEADER_RE)
+                 [&] {
+                   for (const char* q = s; q + 1 < line_end; ++q)
+                     if (q[0] == '-' && q[1] == '>') return true;
+                   return false;
+                 }()) {
+        bool entry = (n > 6 && std::memcmp(s, "ENTRY ", 6) == 0);
+        const char* q = s + (entry ? 6 : 0);
+        q = skip_ws(q, line_end);
+        if (q < line_end && *q == '%') ++q;
+        const char* name_start = q;
+        while (q < line_end && is_ident_char(*q)) ++q;
+        if (q > name_start) {
+          out.field("C", 1);
+          out.field(name_start, q - name_start);
+          out.field(entry ? "1" : "0", 1);
+          out.end_record();
+          in_comp = true;
+        }
+      }
+      // anything else outside a computation (stack-frame tables etc.):skip
+    } else {
+      if (n == 1 && *s == '}') {
+        out.field("E", 1);
+        out.end_record();
+        in_comp = false;
+      } else {
+        scan_instruction(s, line_end, out);
+      }
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  // tolerate an unterminated final computation, like the Python parser
+  if (in_comp) {
+    out.field("E", 1);
+    out.end_record();
+  }
+
+  char* result = static_cast<char*>(std::malloc(out.buf.size() + 1));
+  if (!result) return nullptr;
+  std::memcpy(result, out.buf.data(), out.buf.size());
+  result[out.buf.size()] = '\0';
+  *out_len = out.buf.size();
+  return result;
+}
+
+void hlo_scan_free(char* p) { std::free(p); }
+
+int hlo_scan_abi_version() { return 1; }
+
+}  // extern "C"

@@ -75,7 +75,10 @@ def test_load_config_composition(tmp_path):
 
 def test_derived_quantities():
     a = ArchConfig()
-    assert a.hbm_bytes_per_cycle == pytest.approx(a.hbm_bandwidth / a.clock_hz)
+    assert a.hbm_bytes_per_cycle == pytest.approx(
+        a.hbm_bandwidth * a.hbm_efficiency / a.clock_hz
+    )
+    assert a.vmem_bytes_per_cycle > a.hbm_bytes_per_cycle
     assert a.seconds_to_cycles(1.0) == a.clock_hz
     assert a.mxu_dtype_mult("bf16") == 1.0
     assert a.mxu_dtype_mult("s8") == 2.0

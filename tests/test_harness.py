@@ -1,0 +1,121 @@
+"""Tests for the orchestration harness: procman, scraper, runner, tuner."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpusim.harness.procman import ProcMan
+from tpusim.harness.runner import RunSpec, run_experiments
+from tpusim.harness.scrape import scrape_log, scrape_run_dirs, write_csv
+from tpusim.sim.stats import EXIT_SENTINEL
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+# -- procman ----------------------------------------------------------------
+
+def test_procman_runs_jobs(tmp_path):
+    pm = ProcMan(parallel=2)
+    for i in range(4):
+        pm.submit(
+            [sys.executable, "-c", f"print('job {i}')"],
+            log_path=tmp_path / f"j{i}.log",
+        )
+    assert pm.run(poll_s=0.05)
+    assert pm.status_summary() == {"done": 4}
+    assert "job 2" in (tmp_path / "j2.log").read_text()
+
+
+def test_procman_reports_failure(tmp_path):
+    pm = ProcMan(parallel=2)
+    pm.submit([sys.executable, "-c", "raise SystemExit(3)"],
+              log_path=tmp_path / "bad.log")
+    pm.submit([sys.executable, "-c", "print('ok')"],
+              log_path=tmp_path / "good.log")
+    assert not pm.run(poll_s=0.05)
+    s = pm.status_summary()
+    assert s == {"done": 1, "failed": 1}
+    pm.dump_state(tmp_path / "jobs.json")
+    assert (tmp_path / "jobs.json").exists()
+
+
+# -- scraper ----------------------------------------------------------------
+
+def test_scrape_requires_sentinel(tmp_path):
+    log = tmp_path / "run.log"
+    log.write_text("tpusim_sim_cycle = 123\n")  # no sentinel
+    assert scrape_log(log) is None
+    log.write_text(f"tpusim_sim_cycle = 123\ntpusim_x = 1.5\n{EXIT_SENTINEL}\n")
+    stats = scrape_log(log)
+    assert stats == {"sim_cycle": 123, "x": 1.5}
+
+
+def test_scrape_run_dirs_and_csv(tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "run.log").write_text(
+        f"tpusim_sim_cycle = 10\n{EXIT_SENTINEL}\n"
+    )
+    (tmp_path / "b" / "run.log").write_text("crashed\n")
+    rows = scrape_run_dirs(tmp_path, "**/run.log")
+    assert rows["a/run.log"]["sim_cycle"] == 10
+    assert rows["__failed__"]["runs"] == ["b/run.log"]
+    write_csv(rows, tmp_path / "out.csv")
+    text = (tmp_path / "out.csv").read_text()
+    assert "sim_cycle" in text and "a/run.log" in text
+
+
+# -- runner (end-to-end over a real trace dir) ------------------------------
+
+@pytest.mark.slow
+def test_run_experiments_end_to_end(tmp_path):
+    import jax.numpy as jnp
+
+    from tpusim.tracer.capture import capture_to_dir
+
+    def f(x, w):
+        return (x @ w).sum()
+
+    trace = tmp_path / "trace"
+    capture_to_dir(
+        trace, f, jnp.ones((256, 256), jnp.bfloat16),
+        jnp.ones((256, 256), jnp.bfloat16), name="mini", launches=2,
+    )
+    specs = [
+        RunSpec(trace=trace, arch="v5e", name="mini"),
+        RunSpec(trace=trace, arch="v5p", name="mini",
+                overlays=["-kernel_window 4"], power=True),
+    ]
+    rows = run_experiments(specs, tmp_path / "runs", parallel=2)
+    assert "__failed__" not in rows
+    assert len(rows) == 2
+    for stats in rows.values():
+        assert stats["sim_cycle"] > 0
+    power_rows = [
+        s for s in rows.values() if "power_avg_watts" in s
+    ]
+    assert len(power_rows) == 1
+    assert power_rows[0]["power_avg_watts"] > 0
+
+
+# -- tuner ------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tuner_on_live_chip():
+    """The tuner must land near the calibrated preset on this chip."""
+    import jax
+
+    if jax.devices()[0].platform not in ("tpu",):
+        pytest.skip("tuner fit needs the real chip")
+
+    from tpusim.harness.tuner import tune
+
+    result = tune()
+    assert result.base_arch == "v5e"
+    # measured peak should imply a clock near the calibrated 1.67 GHz
+    assert 1.3 < result.clock_ghz < 2.1, result
+    assert 0.4 < result.hbm_efficiency <= 1.0, result
+    assert 1.0 <= result.vpu_reduce_slowdown < 64, result
+    lines = result.overlay_lines()
+    assert any("clock_ghz" in l for l in lines)

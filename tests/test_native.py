@@ -1,0 +1,139 @@
+"""Contract tests: the native C++ HLO scanner must produce the same IR as
+the pure-Python parser (tpusim/trace/hlo_text.py is the reference
+implementation)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from tpusim.trace.hlo_text import parse_hlo_module
+from tpusim.trace.native import (
+    native_available,
+    parse_hlo_module_fast,
+    parse_hlo_module_native,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures"
+REPO = Path(__file__).parent.parent
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native")], capture_output=True, check=True
+    )
+
+
+def _assert_same_module(a, b):
+    assert a.name == b.name
+    assert a.meta.get("num_partitions") == b.meta.get("num_partitions")
+    assert set(a.computations) == set(b.computations)
+    assert a.entry_name == b.entry_name
+    for cname, comp_a in a.computations.items():
+        comp_b = b.computations[cname]
+        assert len(comp_a.ops) == len(comp_b.ops), cname
+        for oa, ob in zip(comp_a.ops, comp_b.ops):
+            assert oa.name == ob.name
+            assert oa.opcode == ob.opcode
+            assert oa.operands == ob.operands
+            assert oa.is_root == ob.is_root
+            assert str(oa.result) == str(ob.result)
+            assert oa.result.nbytes == ob.result.nbytes
+            assert oa.called == ob.called
+            assert oa.fusion_kind == ob.fusion_kind
+            if oa.collective or ob.collective:
+                assert oa.collective == ob.collective
+            assert oa.attrs.get("literal") == ob.attrs.get("literal")
+
+
+def test_native_builds_and_loads():
+    assert native_available()
+
+
+def test_parity_on_fixture():
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    _assert_same_module(
+        parse_hlo_module(text, "tiny"), parse_hlo_module_native(text, "tiny")
+    )
+
+
+def test_parity_on_live_capture():
+    import jax.numpy as jnp
+
+    from tpusim.tracer.capture import capture
+
+    def f(a, b):
+        return (jnp.maximum(a @ b, 0.0) ** 2).mean()
+
+    cap = capture(
+        f, jnp.ones((128, 256), jnp.bfloat16), jnp.ones((256, 64), jnp.bfloat16),
+        name="parity",
+    )
+    _assert_same_module(
+        parse_hlo_module(cap.hlo_text, "parity"),
+        parse_hlo_module_native(cap.hlo_text, "parity"),
+    )
+
+
+def test_parity_engine_results():
+    """Both parsers must produce identical simulated cycle counts."""
+    from tpusim.timing.config import SimConfig
+    from tpusim.timing.engine import Engine
+
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    cfg = SimConfig()
+    r_py = Engine(cfg).run(parse_hlo_module(text))
+    r_nat = Engine(cfg).run(parse_hlo_module_native(text))
+    assert r_py.cycles == pytest.approx(r_nat.cycles)
+    assert r_py.flops == r_nat.flops
+
+
+def test_fast_path_prefers_native():
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    mod = parse_hlo_module_fast(text)
+    assert mod.entry_name is not None
+
+
+def test_native_speedup_on_large_module():
+    """The native scanner should beat pure Python on a big module."""
+    import time
+
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    # synthesize a large module: many cloned computations
+    body = text.split("ENTRY")[0]
+    clones = []
+    for i in range(300):
+        clones.append(
+            body.replace("region_add", f"region_add_{i}")
+                .replace("fused_relu", f"fused_relu_{i}")
+                .replace("HloModule jit_tiny_mlp, is_scheduled=true, num_partitions=4, replica_count=1", "")
+        )
+    big = text.split("ENTRY")[0] + "\n".join(clones) + "ENTRY" + text.split("ENTRY")[1]
+
+    t0 = time.perf_counter()
+    m_py = parse_hlo_module(big)
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m_nat = parse_hlo_module_native(big)
+    t_nat = time.perf_counter() - t0
+    assert len(m_py.computations) == len(m_nat.computations)
+    # native is usually ~5-10x faster; allow slack for noisy CI machines
+    assert t_nat < t_py * 1.2
+
+def test_native_robust_to_line_ending_variants():
+    """CRLF, trailing whitespace, and %-less headers must parse the same
+    as the Python reference (a trace dir copied through Windows must not
+    silently produce an empty module)."""
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    variants = {
+        "crlf": text.replace("\n", "\r\n"),
+        "trailing_space": text.replace("{\n", "{ \n"),
+        "no_percent_headers": text.replace("\n%region_add", "\nregion_add"),
+    }
+    for label, variant in variants.items():
+        m_py = parse_hlo_module(variant, "v")
+        m_nat = parse_hlo_module_native(variant, "v")
+        assert set(m_py.computations) == set(m_nat.computations), label
+        assert len(m_py.computations) == 3, label
+        _assert_same_module(m_py, m_nat)

@@ -1,0 +1,69 @@
+"""Tests for the TPUWattch power model."""
+
+from pathlib import Path
+
+import pytest
+
+from tpusim.power.model import POWER_PRESETS, PowerModel
+from tpusim.timing.config import SimConfig, overlay
+from tpusim.timing.engine import Engine
+from tpusim.trace.hlo_text import parse_hlo_module
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="module")
+def mlp_result():
+    mod = parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+    return Engine(SimConfig()).run(mod)
+
+
+def test_power_report_components(mlp_result):
+    rep = PowerModel("v5p").report(mlp_result)
+    assert rep.component_joules["mxu"] > 0
+    assert rep.component_joules["hbm"] > 0
+    assert rep.component_joules["ici"] > 0  # the fixture has an all-reduce
+    assert rep.avg_watts > rep.static_watts + rep.idle_watts
+    assert rep.total_joules > rep.dynamic_joules
+
+
+def test_full_utilization_lands_near_tdp():
+    """A chip at 100% MXU + full HBM streaming for 1s must land in the
+    published TDP class (sanity anchor for the coefficients)."""
+    from tpusim.timing.arch import arch_preset
+    from tpusim.timing.engine import EngineResult
+
+    for gen, lo, hi in (("v5e", 100, 300), ("v5p", 250, 700)):
+        arch = arch_preset(gen)
+        res = EngineResult(
+            cycles=arch.clock_hz, seconds=1.0,
+            flops=arch.peak_bf16_flops, mxu_flops=arch.peak_bf16_flops,
+            hbm_bytes=arch.hbm_bandwidth,
+        )
+        watts = PowerModel(gen).report(res).avg_watts
+        assert lo < watts < hi, (gen, watts)
+
+
+def test_power_report_text(mlp_result):
+    text = PowerModel("v5e").report(mlp_result).report_text()
+    assert "TPUWattch power report" in text
+    assert "avg power" in text
+
+
+def test_driver_power_stats():
+    from tpusim.ir import CommandKind, PodTrace, TraceCommand
+    from tpusim.sim.driver import SimDriver
+
+    pod = PodTrace()
+    pod.modules["m"] = parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+    pod.device(0).commands.append(
+        TraceCommand(kind=CommandKind.KERNEL_LAUNCH, module="m")
+    )
+    cfg = overlay(SimConfig(), {"power_enabled": True})
+    report = SimDriver(cfg).run(pod)
+    assert report.stats.get("power_avg_watts") > 0
+    assert report.power is not None
+
+
+def test_presets_exist():
+    assert set(POWER_PRESETS) == {"v4", "v5e", "v5p", "v6e"}

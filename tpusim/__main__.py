@@ -20,9 +20,12 @@ import sys
 def _cmd_simulate(args: argparse.Namespace) -> int:
     from tpusim.sim.driver import simulate_trace
 
-    report = simulate_trace(
-        args.trace, arch=args.arch, overlays=list(args.config or [])
-    )
+    overlays = list(args.config or [])
+    if args.power:
+        overlays.append({"power_enabled": True})
+    report = simulate_trace(args.trace, arch=args.arch, overlays=overlays)
+    if args.power and report.power is not None:
+        print(report.power.report_text())
     report.print_report()
     if args.json:
         with open(args.json, "w") as f:
@@ -66,6 +69,33 @@ def _cmd_info(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_tune(args: argparse.Namespace) -> int:
+    from tpusim.harness.tuner import tune, write_overlay
+
+    result = tune(args.arch)
+    print(json.dumps({
+        "device_kind": result.device_kind,
+        "base_arch": result.base_arch,
+        "clock_ghz": result.clock_ghz,
+        "hbm_efficiency": result.hbm_efficiency,
+        "vpu_reduce_slowdown": result.vpu_reduce_slowdown,
+        "details": result.details,
+    }, indent=2))
+    if args.out:
+        write_overlay(result, args.out)
+        print(f"overlay written to {args.out}")
+    return 0
+
+
+def _cmd_workloads(args: argparse.Namespace) -> int:
+    from tpusim.models import list_workloads
+
+    for wl in sorted(list_workloads(), key=lambda w: (w.suite, w.name)):
+        print(f"{wl.suite:10s} {wl.name:26s} devices={wl.num_devices:<3d} "
+              f"{wl.description}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpusim")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -76,6 +106,8 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--config", action="append",
                     help="overlay flag file(s), applied in order")
     ps.add_argument("--json", default=None, help="also write stats JSON here")
+    ps.add_argument("--power", action="store_true",
+                    help="enable the TPUWattch power model")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")
@@ -87,6 +119,16 @@ def main(argv: list[str] | None = None) -> int:
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")
     pi.set_defaults(fn=_cmd_info)
+
+    pt = sub.add_parser(
+        "tune", help="fit arch parameters on the local chip (tuner)"
+    )
+    pt.add_argument("--arch", default=None)
+    pt.add_argument("--out", default=None, help="write a config overlay here")
+    pt.set_defaults(fn=_cmd_tune)
+
+    pw = sub.add_parser("workloads", help="list registered workloads")
+    pw.set_defaults(fn=_cmd_workloads)
 
     args = p.parse_args(argv)
     try:

@@ -1,0 +1,27 @@
+"""Experiment orchestration + validation harnesses.
+
+The rebuild of the reference's Python tooling layer (``util/``):
+``run_simulations.py`` (job fabrication/launch), ``procman.py`` (local
+process manager), ``get_stats.py`` (stat scraping), ``plot-correlation.py``
+(sim-vs-silicon validation), ``tuner.py`` (microbench-driven config fit).
+"""
+
+from tpusim.harness.correlate import CorrelationPoint, correlate_workload
+from tpusim.harness.procman import Job, ProcMan
+from tpusim.harness.runner import RunSpec, run_experiments
+from tpusim.harness.scrape import scrape_log, scrape_run_dirs, write_csv
+from tpusim.harness.tuner import TunerResult, tune
+
+__all__ = [
+    "CorrelationPoint",
+    "correlate_workload",
+    "Job",
+    "ProcMan",
+    "RunSpec",
+    "run_experiments",
+    "scrape_log",
+    "scrape_run_dirs",
+    "write_csv",
+    "TunerResult",
+    "tune",
+]

@@ -1,0 +1,145 @@
+"""Sim-vs-silicon correlation.
+
+The rebuild of the reference's correlator (``util/plotting/
+plot-correlation.py`` + ``correl_mappings.py``): where that compares
+simulated cycles against nvprof ``Duration × clock`` per kernel per card,
+we compare the timing engine's estimate for a captured HLO module against
+fenced wall-clock measurement of the same program on the live chip.
+
+To defeat per-dispatch RPC overhead (large on tunneled TPU-VMs), a workload
+is wrapped in a ``lax.scan`` of K steps *before* capture, so the same K-step
+program is both simulated (trip count recovered by
+:mod:`tpusim.trace.loop_analysis`) and timed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["CorrelationPoint", "correlate_workload", "loopify"]
+
+
+@dataclass
+class CorrelationPoint:
+    name: str
+    sim_seconds: float
+    real_seconds: float
+    sim_cycles: float
+    flops: float
+    hbm_bytes: float
+
+    @property
+    def error_pct(self) -> float:
+        """Signed cycle error vs silicon, percent (the headline metric —
+        BASELINE.md north-star is |error| <= 15%)."""
+        if self.real_seconds <= 0:
+            return float("inf")
+        return 100.0 * (self.sim_seconds - self.real_seconds) / self.real_seconds
+
+    @property
+    def abs_error_pct(self) -> float:
+        return abs(self.error_pct)
+
+
+def loopify(fn: Callable, n_steps: int) -> Callable:
+    """Wrap ``fn`` in a K-step ``lax.scan`` with a loop-carried dependency.
+
+    The dependency is essential: a body with no carry is loop-invariant and
+    XLA hoists it, leaving an empty loop (you'd time nothing).  The first
+    array argument is threaded as carry — replaced by a same-shaped output
+    leaf when one exists (e.g. an activation chain), otherwise kept alive
+    through a data-dependent no-op select that XLA cannot fold."""
+    import jax
+    import jax.numpy as jnp
+
+    def _signature(tree: Any):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not all(hasattr(l, "shape") for l in leaves):
+            return None
+        return treedef, tuple((l.shape, str(l.dtype)) for l in leaves)
+
+    def looped(first: Any, *rest: Any):
+        first_sig = _signature(first)
+
+        def body(carry, _):
+            out = fn(carry, *rest)
+            # prefer threading a structurally matching output (e.g. the
+            # updated params of a train step, or the activation chain)
+            candidates = [out]
+            if isinstance(out, (tuple, list)):
+                candidates.extend(out)
+            for cand in candidates:
+                if first_sig is not None and _signature(cand) == first_sig:
+                    return cand, ()
+            # No structural match: feed a vanishing function of the output
+            # back into ONE element of the carry (a 1-element
+            # dynamic-update-slice — negligible cost, but a true data
+            # dependency).  NB: an isnan/select guard is NOT safe here —
+            # XLA:TPU's no-NaN assumption folds it and then hoists the
+            # whole "loop-invariant" body, timing an empty loop.
+            leaves = [
+                l for l in jax.tree_util.tree_leaves(out)
+                if hasattr(l, "shape")
+            ]
+            s = sum(
+                jnp.sum(l.astype(jnp.float32)) for l in leaves
+            ) if leaves else jnp.float32(0)
+            tiny = (s * jnp.float32(1e-30)).astype(jnp.float32)
+
+            injected = False
+            def inject(c):
+                nonlocal injected
+                if injected or not hasattr(c, "shape"):
+                    return c
+                injected = True
+                idx = (0,) * c.ndim
+                return c.at[idx].add(tiny.astype(c.dtype))
+
+            kept = jax.tree_util.tree_map(inject, carry)
+            return kept, ()
+
+        final, _ = jax.lax.scan(body, first, None, length=n_steps)
+        return final
+
+    looped.__name__ = f"loop{n_steps}_{getattr(fn, '__name__', 'fn')}"
+    return looped
+
+
+def correlate_workload(
+    fn: Callable,
+    args: tuple,
+    *,
+    name: str = "workload",
+    n_steps: int = 16,
+    arch: str | None = None,
+    iters: int = 3,
+) -> CorrelationPoint:
+    """Capture, simulate, and silicon-time one workload; returns the point.
+
+    ``arch=None`` auto-detects from the local device kind."""
+    import jax
+
+    from tpusim.timing.arch import detect_arch
+    from tpusim.timing.config import SimConfig, load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.tracer.capture import capture, measure_wall_time
+
+    looped = loopify(fn, n_steps)
+
+    cap = capture(looped, *args, name=name)
+    if arch is None:
+        cfg = SimConfig(arch=detect_arch(jax.devices()[0].device_kind))
+    else:
+        cfg = load_config(arch=arch)
+    res = Engine(cfg).run(cap.module)
+
+    t = measure_wall_time(looped, *args, iters=iters)
+    return CorrelationPoint(
+        name=name,
+        sim_seconds=res.seconds / n_steps,
+        real_seconds=t["median_s"] / n_steps,
+        sim_cycles=res.cycles / n_steps,
+        flops=res.flops / n_steps,
+        hbm_bytes=res.hbm_bytes / n_steps,
+    )

@@ -1,0 +1,140 @@
+"""Local process manager — the rebuild of ``util/job_launching/procman.py``
+(the reference's dependency-free slurm substitute, ``procman.py:11-35``):
+run a queue of jobs with bounded parallelism, track status, persist state.
+
+This is the "fake cluster" for laptops/CI; torque/slurm submission can slot
+in behind the same interface later (``run_simulations.py:376-397`` selects
+launchers the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = ["Job", "ProcMan"]
+
+
+@dataclass
+class Job:
+    job_id: int
+    cmd: list[str]
+    cwd: str | None = None
+    log_path: str | None = None
+    env: dict[str, str] | None = None
+    status: str = "pending"       # pending | running | done | failed
+    returncode: int | None = None
+    started_at: float | None = None
+    finished_at: float | None = None
+
+    _proc: subprocess.Popen | None = field(default=None, repr=False)
+    _log_f: object | None = field(default=None, repr=False)
+
+
+class ProcMan:
+    """Run jobs locally with at most ``parallel`` concurrent processes."""
+
+    def __init__(self, parallel: int | None = None):
+        self.parallel = parallel or max((os.cpu_count() or 2) // 2, 1)
+        self.jobs: list[Job] = []
+
+    def submit(
+        self,
+        cmd: list[str],
+        *,
+        cwd: str | Path | None = None,
+        log_path: str | Path | None = None,
+        env: dict[str, str] | None = None,
+    ) -> Job:
+        job = Job(
+            job_id=len(self.jobs),
+            cmd=[str(c) for c in cmd],
+            cwd=str(cwd) if cwd else None,
+            log_path=str(log_path) if log_path else None,
+            env=env,
+        )
+        self.jobs.append(job)
+        return job
+
+    # -- scheduling --------------------------------------------------------
+
+    def _start(self, job: Job) -> None:
+        log_f = None
+        if job.log_path:
+            Path(job.log_path).parent.mkdir(parents=True, exist_ok=True)
+            log_f = open(job.log_path, "w")
+        env = dict(os.environ)
+        if job.env:
+            env.update(job.env)
+        job._proc = subprocess.Popen(
+            job.cmd, cwd=job.cwd, env=env,
+            stdout=log_f or subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        job._log_f = log_f
+        job.status = "running"
+        job.started_at = time.time()
+
+    def _reap(self, job: Job) -> None:
+        assert job._proc is not None
+        rc = job._proc.poll()
+        if rc is None:
+            return
+        job.returncode = rc
+        job.status = "done" if rc == 0 else "failed"
+        job.finished_at = time.time()
+        if job._log_f is not None:
+            job._log_f.close()  # type: ignore[attr-defined]
+            job._log_f = None
+        job._proc = None
+
+    def step(self) -> bool:
+        """Advance the scheduler one tick; returns True while work remains."""
+        running = [j for j in self.jobs if j.status == "running"]
+        for j in running:
+            self._reap(j)
+        running = [j for j in self.jobs if j.status == "running"]
+        pending = [j for j in self.jobs if j.status == "pending"]
+        for j in pending[: max(self.parallel - len(running), 0)]:
+            self._start(j)
+        return any(j.status in ("pending", "running") for j in self.jobs)
+
+    def run(self, poll_s: float = 0.2, timeout_s: float | None = None) -> bool:
+        """Run until all jobs finish.  Returns True if all succeeded."""
+        deadline = time.time() + timeout_s if timeout_s else None
+        while self.step():
+            if deadline and time.time() > deadline:
+                self.kill_all()
+                return False
+            time.sleep(poll_s)
+        return all(j.status == "done" for j in self.jobs)
+
+    def kill_all(self) -> None:
+        for j in self.jobs:
+            if j._proc is not None:
+                j._proc.kill()
+                j.status = "failed"
+
+    # -- reporting ---------------------------------------------------------
+
+    def status_summary(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for j in self.jobs:
+            out[j.status] = out.get(j.status, 0) + 1
+        return out
+
+    def dump_state(self, path: str | Path) -> None:
+        state = [
+            {
+                "job_id": j.job_id, "cmd": j.cmd, "status": j.status,
+                "returncode": j.returncode, "log": j.log_path,
+                "started_at": j.started_at, "finished_at": j.finished_at,
+            }
+            for j in self.jobs
+        ]
+        with open(path, "w") as f:
+            json.dump(state, f, indent=2)

@@ -1,0 +1,105 @@
+"""Stat scraping — the rebuild of ``util/job_launching/get_stats.py``.
+
+The reference scrapes simulator stdout with YAML-configured regexes and
+declares a run successful only when the log contains
+``GPGPU-Sim: *** exit detected ***`` (``get_stats.py:224-246``).  We keep
+the exact same contract: logs are scanned for ``tpusim_<name> = <value>``
+lines, gated on :data:`tpusim.sim.stats.EXIT_SENTINEL`, and emitted as
+rows — plus the structured-JSON fast path when a ``--json`` stats file is
+present next to the log.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import re
+from pathlib import Path
+from typing import Iterable
+
+from tpusim.sim.stats import EXIT_SENTINEL, STAT_PREFIX
+
+__all__ = ["scrape_log", "scrape_run_dirs", "write_csv"]
+
+_STAT_RE = re.compile(
+    rf"^{re.escape(STAT_PREFIX)}(?P<name>[\w.]+)\s*=\s*(?P<value>\S+)\s*$"
+)
+
+
+def scrape_log(path: str | Path) -> dict[str, object] | None:
+    """Parse one run log.  Returns None if the run did not complete (no
+    exit sentinel — the reference's failure criterion)."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    text = path.read_text(errors="replace")
+    if EXIT_SENTINEL not in text:
+        return None
+
+    # structured fast path: a stats JSON written alongside
+    sidecar = path.with_suffix(".stats.json")
+    if sidecar.exists():
+        try:
+            return json.loads(sidecar.read_text())
+        except json.JSONDecodeError:
+            pass
+
+    stats: dict[str, object] = {}
+    for line in text.splitlines():
+        m = _STAT_RE.match(line.strip())
+        if not m:
+            continue
+        raw = m.group("value")
+        try:
+            val: object = int(raw)
+        except ValueError:
+            try:
+                val = float(raw)
+            except ValueError:
+                val = raw
+        stats[m.group("name")] = val
+    return stats
+
+
+def scrape_run_dirs(
+    root: str | Path, pattern: str = "**/*.log"
+) -> dict[str, dict[str, object]]:
+    """Scrape every log under ``root``; key = path relative to root.
+    Failed runs (no sentinel) appear with value None-filtered out but are
+    reported in the '__failed__' list."""
+    root = Path(root)
+    out: dict[str, dict[str, object]] = {}
+    failed: list[str] = []
+    for log in sorted(root.glob(pattern)):
+        rel = str(log.relative_to(root))
+        stats = scrape_log(log)
+        if stats is None:
+            failed.append(rel)
+        else:
+            out[rel] = stats
+    if failed:
+        out["__failed__"] = {"runs": failed}  # type: ignore[assignment]
+    return out
+
+
+def write_csv(
+    rows: dict[str, dict[str, object]], path: str | Path,
+    columns: Iterable[str] | None = None,
+) -> None:
+    rows = {k: v for k, v in rows.items() if k != "__failed__"}
+    if not rows:
+        Path(path).write_text("")
+        return
+    if columns is None:
+        cols: list[str] = []
+        for stats in rows.values():
+            for k in stats:
+                if k not in cols:
+                    cols.append(k)
+    else:
+        cols = list(columns)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["run"] + cols)
+        for run, stats in sorted(rows.items()):
+            w.writerow([run] + [stats.get(c, "") for c in cols])

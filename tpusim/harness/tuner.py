@@ -1,0 +1,125 @@
+"""Microbenchmark tuner — the rebuild of ``util/tuner/tuner.py``.
+
+The reference runs ~30 CUDA microbenchmarks that each print config lines,
+then splices them into ``gpgpusim.config`` templates
+(``tuner.py:23-67``).  Ours runs unit-isolating JAX microbenches on the
+live chip (through the fenced correlation harness) and *fits* the arch
+parameters they expose:
+
+* ``clock_ghz``        from sustained bf16 matmul throughput (MXU peak)
+* ``hbm_efficiency``   from streamed elementwise bandwidth
+* ``vpu_reduce_slowdown`` from large-reduction throughput
+
+emitting a reference-style flag-file overlay (``-arch.clock_ghz 1.67``)
+that ``load_config`` composes — exactly how tuner output feeds
+``run_simulations.py`` in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+__all__ = ["TunerResult", "tune", "write_overlay"]
+
+
+@dataclass
+class TunerResult:
+    device_kind: str
+    base_arch: str
+    clock_ghz: float | None = None
+    hbm_efficiency: float | None = None
+    vpu_reduce_slowdown: float | None = None
+    details: dict | None = None
+
+    def overlay_lines(self) -> list[str]:
+        lines = [f"# tpusim tuner fit for {self.device_kind}"]
+        if self.clock_ghz:
+            lines.append(f"-arch.clock_ghz {self.clock_ghz:.4g}")
+        if self.hbm_efficiency:
+            lines.append(f"-arch.hbm_efficiency {self.hbm_efficiency:.4g}")
+        if self.vpu_reduce_slowdown:
+            lines.append(
+                f"-arch.vpu_reduce_slowdown {self.vpu_reduce_slowdown:.4g}"
+            )
+        return lines
+
+
+def _fit_clock(arch, n_steps: int = 16) -> tuple[float, float]:
+    """Sustained big-matmul rate → implied clock (MXU count/size fixed)."""
+    from tpusim.harness.correlate import loopify
+    from tpusim.models import get_workload
+    from tpusim.tracer.capture import measure_wall_time
+
+    fn, args = get_workload("matmul").build(m=4096, n=4096, k=4096)
+    looped = loopify(fn, n_steps)
+    t = measure_wall_time(looped, *args, iters=3)
+    per_step = t["min_s"] / n_steps
+    flops = 2.0 * 4096 ** 3
+    achieved = flops / per_step
+    flops_per_cycle = 2.0 * arch.mxu_count * arch.mxu_rows * arch.mxu_cols
+    implied_clock = achieved / flops_per_cycle / 1e9
+    return implied_clock, achieved
+
+
+def _fit_hbm(arch, n_steps: int = 16) -> tuple[float, float]:
+    """Streamed elementwise bandwidth → HBM efficiency."""
+    from tpusim.harness.correlate import loopify
+    from tpusim.models import get_workload
+    from tpusim.tracer.capture import measure_wall_time
+
+    elems = 32 * 1024 * 1024
+    fn, args = get_workload("elementwise_stream").build(elems=elems)
+    looped = loopify(fn, n_steps)
+    t = measure_wall_time(looped, *args, iters=3)
+    per_step = t["min_s"] / n_steps
+    nbytes = 2.0 * elems * 4            # read + write f32
+    achieved = nbytes / per_step
+    return min(achieved / arch.hbm_bandwidth, 1.0), achieved
+
+
+def _fit_reduce(arch, clock_ghz: float, n_steps: int = 64) -> float:
+    """Large lane-dim reduction rate → VPU reduce slowdown factor."""
+    from tpusim.harness.correlate import loopify
+    from tpusim.models import get_workload
+    from tpusim.tracer.capture import measure_wall_time
+
+    rows = cols = 4096
+    fn, args = get_workload("reduction").build(rows=rows, cols=cols)
+    looped = loopify(fn, n_steps)
+    t = measure_wall_time(looped, *args, iters=3)
+    per_step = t["min_s"] / n_steps
+    elems = float(rows * cols)
+    elems_per_cycle = elems / (per_step * clock_ghz * 1e9)
+    vpu_rate = arch.vpu_sublanes * arch.vpu_lanes * arch.vpu_alus
+    return max(vpu_rate / max(elems_per_cycle, 1e-9), 1.0)
+
+
+def tune(arch_name: str | None = None) -> TunerResult:
+    """Run the fit suite on the local device."""
+    import jax
+
+    from tpusim.timing.arch import arch_preset, detect_arch
+
+    dev = jax.devices()[0]
+    arch = arch_preset(arch_name) if arch_name else detect_arch(dev.device_kind)
+
+    clock, mxu_achieved = _fit_clock(arch)
+    hbm_eff, hbm_achieved = _fit_hbm(arch)
+    reduce_slow = _fit_reduce(arch, clock)
+
+    return TunerResult(
+        device_kind=dev.device_kind,
+        base_arch=arch.name,
+        clock_ghz=round(clock, 3),
+        hbm_efficiency=round(hbm_eff, 3),
+        vpu_reduce_slowdown=round(reduce_slow, 2),
+        details={
+            "mxu_achieved_tflops": mxu_achieved / 1e12,
+            "hbm_achieved_gbps": hbm_achieved / 1e9,
+        },
+    )
+
+
+def write_overlay(result: TunerResult, path: str | Path) -> None:
+    Path(path).write_text("\n".join(result.overlay_lines()) + "\n")

@@ -1,0 +1,18 @@
+"""TPUWattch — the AccelWattch rebuild for TPU units.
+
+The reference's power layer (``src/accelwattch/``, a McPAT/CACTI fork) maps
+per-pipeline activity counters to per-component dynamic power plus static
+power, sampled from ``gpgpu_sim::cycle()`` (``gpu-sim.cc:1993-2001``) with
+an opcode→component table (``ISA_Def/accelwattch_component_mapping.h``).
+
+Ours maps the timing engine's counters — MXU flops, VPU ops,
+transcendentals, HBM/vmem/ICI bytes, unit busy cycles — through per-unit
+energy coefficients (pJ/op, pJ/byte) re-fit to TPU generations, plus
+leakage and idle components.  Counters were plumbed from day 1
+(SURVEY.md §7 step 9): :class:`tpusim.timing.engine.EngineResult` is the
+``power_stat.h`` equivalent.
+"""
+
+from tpusim.power.model import PowerCoefficients, PowerModel, PowerReport
+
+__all__ = ["PowerCoefficients", "PowerModel", "PowerReport"]

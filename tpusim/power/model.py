@@ -1,0 +1,130 @@
+"""Per-unit energy/power model.
+
+Energy coefficients are first-principles estimates for a ~5nm-class TPU,
+chosen so the derived chip power at full utilization lands near published
+TDPs (v5e ~ 200W class, v5p ~ 500W class); the fitting harness
+(:mod:`tpusim.harness.tuner`) can refine them when real power telemetry is
+available — the analogue of AccelWattch's quadprog coefficient fit
+(``util/accelwattch/quadprog_solver.m``, ``AccelWattch.md:110-125``).
+
+Model: for one simulated execution,
+
+    E_dyn  = mxu_pj * mxu_flops + vpu_pj * vpu_ops + sfu_pj * transcendentals
+           + hbm_pj * hbm_bytes + vmem_pj * vmem_bytes + ici_pj * ici_bytes
+    P_avg  = E_dyn / t + P_static + P_idle_clock
+
+mirroring AccelWattch's dynamic-activity × per-access-energy + leakage
+split (``gpgpu_sim_wrapper.cc``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpusim.timing.engine import EngineResult
+
+__all__ = ["PowerCoefficients", "PowerModel", "PowerReport"]
+
+
+@dataclass(frozen=True)
+class PowerCoefficients:
+    """pJ per event, plus static watts — one set per TPU generation."""
+
+    name: str = "v5p"
+    mxu_pj_per_flop: float = 0.6       # bf16 MAC energy amortized
+    vpu_pj_per_flop: float = 1.2
+    sfu_pj_per_op: float = 4.0         # transcendentals
+    hbm_pj_per_byte: float = 6.0       # HBM2e/3-class access energy
+    vmem_pj_per_byte: float = 0.8      # on-chip SRAM
+    ici_pj_per_byte: float = 10.0      # SerDes + link
+    static_watts: float = 70.0         # leakage
+    idle_clock_watts: float = 35.0     # clock tree / sequencer
+
+
+#: per-generation coefficient presets (fit targets: published TDP class)
+POWER_PRESETS: dict[str, PowerCoefficients] = {
+    "v4": PowerCoefficients(name="v4", mxu_pj_per_flop=0.35,
+                            static_watts=55.0),
+    "v5e": PowerCoefficients(name="v5e", mxu_pj_per_flop=0.30,
+                             static_watts=40.0, idle_clock_watts=20.0),
+    "v5p": PowerCoefficients(name="v5p"),
+    "v6e": PowerCoefficients(name="v6e", mxu_pj_per_flop=0.18,
+                             static_watts=45.0),
+}
+
+
+@dataclass
+class PowerReport:
+    """Per-component energy breakdown for one simulated execution — the
+    ``accelwattch_power_report.log`` equivalent."""
+
+    seconds: float
+    component_joules: dict[str, float] = field(default_factory=dict)
+    static_watts: float = 0.0
+    idle_watts: float = 0.0
+
+    @property
+    def dynamic_joules(self) -> float:
+        return sum(self.component_joules.values())
+
+    @property
+    def total_joules(self) -> float:
+        return (
+            self.dynamic_joules
+            + (self.static_watts + self.idle_watts) * self.seconds
+        )
+
+    @property
+    def avg_watts(self) -> float:
+        if self.seconds <= 0:
+            return 0.0
+        return self.total_joules / self.seconds
+
+    def stats_dict(self) -> dict[str, float]:
+        d = {
+            "power_avg_watts": self.avg_watts,
+            "energy_total_j": self.total_joules,
+            "energy_dynamic_j": self.dynamic_joules,
+            "power_static_watts": self.static_watts + self.idle_watts,
+        }
+        for comp, j in self.component_joules.items():
+            d[f"energy_{comp}_j"] = j
+        return d
+
+    def report_text(self) -> str:
+        lines = ["TPUWattch power report", "-" * 40]
+        lines.append(f"elapsed            = {self.seconds:.6g} s")
+        for comp, j in sorted(self.component_joules.items()):
+            w = j / self.seconds if self.seconds else 0.0
+            lines.append(f"{comp:18s} = {j:.6g} J ({w:.3g} W)")
+        lines.append(f"{'static+idle':18s} = "
+                     f"{(self.static_watts + self.idle_watts) * self.seconds:.6g} J "
+                     f"({self.static_watts + self.idle_watts:.3g} W)")
+        lines.append(f"{'avg power':18s} = {self.avg_watts:.6g} W")
+        return "\n".join(lines)
+
+
+class PowerModel:
+    def __init__(self, coeffs: PowerCoefficients | str = "v5p"):
+        if isinstance(coeffs, str):
+            coeffs = POWER_PRESETS.get(coeffs, PowerCoefficients(name=coeffs))
+        self.coeffs = coeffs
+
+    def report(self, result: EngineResult) -> PowerReport:
+        c = self.coeffs
+        pj = {
+            "mxu": c.mxu_pj_per_flop * result.mxu_flops,
+            "vpu": c.vpu_pj_per_flop * max(
+                result.flops - result.mxu_flops - result.transcendentals, 0.0
+            ),
+            "sfu": c.sfu_pj_per_op * result.transcendentals,
+            "hbm": c.hbm_pj_per_byte * result.hbm_bytes,
+            "vmem": c.vmem_pj_per_byte * result.vmem_bytes,
+            "ici": c.ici_pj_per_byte * result.ici_bytes,
+        }
+        return PowerReport(
+            seconds=max(result.seconds, 1e-12),
+            component_joules={k: v * 1e-12 for k, v in pj.items()},
+            static_watts=c.static_watts,
+            idle_watts=c.idle_clock_watts,
+        )

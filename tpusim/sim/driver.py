@@ -55,6 +55,7 @@ class SimReport:
     collective_cmd_cycles: float = 0.0
     wall_seconds: float = 0.0       # host time spent simulating
     stats: StatsRegistry = field(default_factory=StatsRegistry)
+    power: object | None = None     # PowerReport when power_enabled
 
     @property
     def cycles(self) -> float:
@@ -208,6 +209,12 @@ class SimDriver:
 
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)
+        if cfg.power_enabled:
+            from tpusim.power.model import PowerModel
+
+            preport = PowerModel(arch.name).report(report.totals)
+            report.stats.update(preport.stats_dict(), prefix="")
+            report.power = preport
         return report
 
 

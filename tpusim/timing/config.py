@@ -90,6 +90,10 @@ class ArchConfig:
     vpu_alus: int = 4                  # parallel ALU ops per lane per cycle
     # transcendental ops (exp/log/tanh/...) per cycle across the VPU
     vpu_transcendental_per_cycle: int = 512
+    # cross-lane reductions run below elementwise rate (measured ~9x on
+    # v5e silicon for a full 2D sum, marginal cost with fixed per-program
+    # copies excluded — see bench.py calibration)
+    vpu_reduce_slowdown: float = 9.0
 
     # --- scalar / control -------------------------------------------------
     scalar_op_cycles: int = 1
@@ -97,7 +101,10 @@ class ArchConfig:
     op_overhead_cycles: int = 35
 
     # --- memory -----------------------------------------------------------
-    hbm_bandwidth: float = 2765e9      # bytes/sec
+    hbm_bandwidth: float = 2765e9      # bytes/sec, pin peak
+    # achieved fraction of peak for streaming access (refresh, bank
+    # conflicts, DMA gaps); calibrated on v5e silicon via bench.py
+    hbm_efficiency: float = 0.72
     hbm_latency: float = 700e-9        # seconds, first-byte
     hbm_gib: float = 95.7
     vmem_bytes: int = 128 * 1024 * 1024
@@ -129,7 +136,11 @@ class ArchConfig:
 
     @property
     def hbm_bytes_per_cycle(self) -> float:
-        return self.hbm_bandwidth / self.clock_hz
+        return self.hbm_bandwidth * self.hbm_efficiency / self.clock_hz
+
+    @property
+    def vmem_bytes_per_cycle(self) -> float:
+        return self.vmem_bandwidth_mult * self.hbm_bandwidth / self.clock_hz
 
     def seconds_to_cycles(self, s: float) -> float:
         return s * self.clock_hz

@@ -64,6 +64,11 @@ DATA_MOVEMENT_OPS = frozenset({
 
 REDUCE_OPS = frozenset({"reduce", "reduce-window", "select-and-scatter"})
 
+#: ops whose cost is set by the moved region, not the full buffers
+_REGION_OPS = frozenset({
+    "slice", "dynamic-slice", "dynamic-update-slice", "gather", "scatter",
+})
+
 _TRIP_COUNT_RE = re.compile(r'known_trip_count[^0-9]*?(\d+)')
 _INDUCTION_RE = re.compile(r'known_induction_variable')
 
@@ -170,6 +175,43 @@ def _operand_bytes(comp: Computation, op: TraceOp) -> int:
     return total
 
 
+def _region_bytes(comp: Computation, op: TraceOp) -> float:
+    """Bytes actually moved by a slice-like op: read + write of the
+    region.  For dynamic-update-slice the region is the update operand;
+    for the others it's the result."""
+    if op.base == "dynamic-update-slice" and len(op.operands) >= 2:
+        region = _leaf_shape(comp, op.operands[1]).nbytes
+    else:
+        region = sum(l.nbytes for l in leaves_of(op.result))
+    return 2.0 * region
+
+
+def _memory_bytes(comp: Computation, op: TraceOp) -> tuple[float, float]:
+    """(hbm_bytes, vmem_bytes) touched by one op: operands + result, split
+    by the layout's memory space.  XLA:TPU marks vmem-pinned buffers with
+    ``S(1)`` in the layout (observed on loop carries XLA keeps resident in
+    the 128MB vmem); default space 0 is HBM."""
+    hbm = 0.0
+    vmem = 0.0
+    seen = set()
+
+    def account(spec) -> None:
+        nonlocal hbm, vmem
+        for leaf in leaves_of(spec):
+            if leaf.memory_space != 0:
+                vmem += leaf.nbytes
+            else:
+                hbm += leaf.nbytes
+
+    for name in op.operands:
+        if name in seen or not comp.has_op(name):
+            continue
+        seen.add(name)
+        account(comp.op(name).result)
+    account(op.result)
+    return hbm, vmem
+
+
 # ---------------------------------------------------------------------------
 # Cost record
 # ---------------------------------------------------------------------------
@@ -187,6 +229,7 @@ class OpCost:
     mxu_flops: float = 0.0
     transcendentals: float = 0.0
     hbm_bytes: float = 0.0
+    vmem_bytes: float = 0.0
     ici_bytes: float = 0.0
     is_async: bool = False
 
@@ -278,7 +321,10 @@ class CostModel:
                         wnd *= int(d)
                 in_elems *= max(wnd, 1)
             c.flops = float(in_elems)
-            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            # cross-lane reductions run well below elementwise rate
+            c.compute_cycles = self._vpu_cycles(
+                c.flops * self.arch.vpu_reduce_slowdown, 0
+            )
             c.unit = Unit.VPU
         elif base == "transpose":
             c.unit = Unit.TRANSPOSE
@@ -352,12 +398,43 @@ class CostModel:
             return OpCost(unit=Unit.NONE)
 
         c = self._compute_cost(op, comp, module)
-        c.hbm_bytes = float(_operand_bytes(comp, op) + op.result.nbytes)
-        if base == "fusion":
-            # async-fused copies inside don't re-read; roofline over operands
-            # + outputs is the standard fusion assumption (SURVEY.md §7)
-            pass
-        c.mem_cycles = c.hbm_bytes / a.hbm_bytes_per_cycle
+        # roofline over operands + outputs (the standard fusion assumption,
+        # SURVEY.md §7), split by memory space: vmem-resident buffers
+        # stream at vmem bandwidth, everything else at achieved HBM rate
+        c.hbm_bytes, c.vmem_bytes = _memory_bytes(comp, op)
+        if base in _REGION_OPS:
+            # slice-like ops touch only the moved region; XLA aliases the
+            # untouched remainder in place (a full-buffer charge made a
+            # 1-element dynamic-update-slice cost a 64MB stream)
+            region = _region_bytes(comp, op)
+            c.hbm_bytes = min(c.hbm_bytes, region)
+            c.vmem_bytes = min(c.vmem_bytes, region)
+        elif base == "copy":
+            # a copy moves its payload once; async copy-start results are
+            # (src, dst, ctx) tuples, so operand+result charging counts the
+            # payload up to 3x.  Cross-port (HBM<->vmem) transfers stream
+            # the payload once through each port; same-port copies read and
+            # write through the one port (2x payload on it).
+            payload = float(max(
+                (l.nbytes for o in op.operands[:1] if comp.has_op(o)
+                 for l in leaves_of(comp.op(o).result)),
+                default=op.result.nbytes,
+            ))
+            touches_hbm = c.hbm_bytes > 0
+            touches_vmem = c.vmem_bytes > 0
+            if touches_hbm and touches_vmem:
+                c.hbm_bytes = payload
+                c.vmem_bytes = payload
+            elif touches_vmem:
+                c.hbm_bytes = 0.0
+                c.vmem_bytes = 2.0 * payload
+            else:
+                c.hbm_bytes = 2.0 * payload
+                c.vmem_bytes = 0.0
+        c.mem_cycles = max(
+            c.hbm_bytes / a.hbm_bytes_per_cycle,
+            c.vmem_bytes / a.vmem_bytes_per_cycle,
+        )
         c.cycles = a.op_overhead_cycles + max(c.compute_cycles, c.mem_cycles)
         c.is_async = op.is_async_start
         if op.opcode in ("copy-start",):

@@ -50,6 +50,7 @@ class EngineResult:
     mxu_flops: float = 0.0
     transcendentals: float = 0.0
     hbm_bytes: float = 0.0
+    vmem_bytes: float = 0.0
     ici_bytes: float = 0.0
     collective_count: int = 0
     collective_cycles: float = 0.0       # total ICI busy cycles
@@ -86,6 +87,7 @@ class EngineResult:
         self.mxu_flops += other.mxu_flops * times
         self.transcendentals += other.transcendentals * times
         self.hbm_bytes += other.hbm_bytes * times
+        self.vmem_bytes += other.vmem_bytes * times
         self.ici_bytes += other.ici_bytes * times
         self.collective_count += int(other.collective_count * times)
         self.collective_cycles += other.collective_cycles * times
@@ -105,6 +107,7 @@ class EngineResult:
             "flops": self.flops,
             "mxu_flops": self.mxu_flops,
             "hbm_bytes": self.hbm_bytes,
+            "vmem_bytes": self.vmem_bytes,
             "ici_bytes": self.ici_bytes,
             "collective_count": self.collective_count,
             "collective_cycles": self.collective_cycles,
@@ -302,6 +305,7 @@ class Engine:
             result.mxu_flops += cost.mxu_flops
             result.transcendentals += cost.transcendentals
             result.hbm_bytes += cost.hbm_bytes
+            result.vmem_bytes += cost.vmem_bytes
             if dur > 0:
                 result.unit_busy_cycles[cost.unit.value] += dur
                 result.opcode_cycles[base] += dur

@@ -32,7 +32,6 @@ from tpusim.ir import (
     PodTrace,
     TraceCommand,
 )
-from tpusim.trace.hlo_text import parse_hlo_module
 
 __all__ = ["TraceDir", "save_trace", "load_trace", "parse_commandlist"]
 
@@ -176,11 +175,13 @@ def load_trace(path: str | Path) -> PodTrace:
         with open(meta_path) as f:
             meta = json.load(f)
 
+    from tpusim.trace.native import parse_hlo_module_fast
+
     pod = PodTrace(meta=meta)
     modules_dir = path / "modules"
     if modules_dir.is_dir():
         for mp in sorted(modules_dir.glob("*.hlo")):
-            mod = parse_hlo_module(mp.read_text(), name_hint=mp.stem)
+            mod = parse_hlo_module_fast(mp.read_text(), name_hint=mp.stem)
             # file name is the trace key; HloModule header name may differ
             pod.modules[mp.stem] = mod
             mod.meta.setdefault("trace_key", mp.stem)

@@ -413,6 +413,23 @@ _MODULE_RE = re.compile(r"^HloModule\s+(?P<name>[\w.\-]+)\s*(?:,\s*(?P<attrs>.*)
 _MODULE_INT_ATTRS = ("replica_count", "num_partitions")
 
 
+def parse_module_attrs(attr_text: str, meta: dict) -> None:
+    """Parse the HloModule header attr list into ``meta`` (shared by the
+    Python and native parsers)."""
+    for tok in split_top_level(attr_text):
+        key, eq, val = tok.partition("=")
+        if not eq:
+            continue
+        key, val = key.strip(), val.strip()
+        if key in _MODULE_INT_ATTRS:
+            try:
+                meta[key] = int(val)
+            except ValueError:
+                pass
+        elif key == "is_scheduled":
+            meta[key] = val == "true"
+
+
 def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
     """Parse a full HLO module text dump into a :class:`ModuleTrace`.
 
@@ -445,19 +462,7 @@ def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
         mm = _MODULE_RE.match(stripped)
         if mm and current is None:
             module.name = mm.group("name")
-            attr_text = mm.group("attrs") or ""
-            for tok in split_top_level(attr_text):
-                key, eq, val = tok.partition("=")
-                if not eq:
-                    continue
-                key, val = key.strip(), val.strip()
-                if key in _MODULE_INT_ATTRS:
-                    try:
-                        module.meta[key] = int(val)
-                    except ValueError:
-                        pass
-                elif key == "is_scheduled":
-                    module.meta[key] = val == "true"
+            parse_module_attrs(mm.group("attrs") or "", module.meta)
             continue
 
         ch = _COMP_HEADER_RE.match(stripped)

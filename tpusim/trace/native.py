@@ -1,0 +1,155 @@
+"""ctypes bridge to the native HLO scanner (``native/hlo_scan.cpp``).
+
+The structural pass (line classification, balanced-delimiter splitting,
+operand extraction) runs in C++; this module rebuilds :mod:`tpusim.ir`
+objects from the pre-split record stream.  Falls back transparently to the
+pure-Python parser when the shared library hasn't been built — the two
+paths are contract-tested against each other (tests/test_native.py).
+
+Build with ``make -C native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+from tpusim.ir import Computation, ModuleTrace, TraceOp
+from tpusim.trace import hlo_text as pyparse
+
+__all__ = ["native_available", "parse_hlo_module_native", "parse_hlo_module_fast"]
+
+_RS = "\x1e"
+_US = "\x1f"
+
+_LIB: ctypes.CDLL | None = None
+_LIB_TRIED = False
+
+
+def _lib_path() -> Path:
+    return (
+        Path(__file__).resolve().parent.parent.parent
+        / "native" / "libtpusim_native.so"
+    )
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    path = _lib_path()
+    if not path.exists() or os.environ.get("TPUSIM_NO_NATIVE"):
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+        lib.hlo_scan.restype = ctypes.POINTER(ctypes.c_char)
+        lib.hlo_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.hlo_scan_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        lib.hlo_scan_abi_version.restype = ctypes.c_int
+        if lib.hlo_scan_abi_version() != 1:
+            return None
+        _LIB = lib
+    except OSError:
+        return None
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _scan(text: str) -> str:
+    lib = _load()
+    assert lib is not None
+    raw = text.encode("utf-8", errors="replace")
+    out_len = ctypes.c_uint64(0)
+    ptr = lib.hlo_scan(raw, len(raw), ctypes.byref(out_len))
+    if not ptr:
+        raise MemoryError("hlo_scan allocation failed")
+    try:
+        return ctypes.string_at(ptr, out_len.value).decode(
+            "utf-8", errors="replace"
+        )
+    finally:
+        lib.hlo_scan_free(ptr)
+
+
+def parse_hlo_module_native(text: str, name_hint: str = "module") -> ModuleTrace:
+    """Parse using the native scanner (raises if unavailable)."""
+    stream = _scan(text)
+    module = ModuleTrace(name=name_hint)
+    current: Computation | None = None
+
+    for record in stream.split(_RS):
+        if not record:
+            continue
+        fields = record.split(_US)
+        kind = fields[0]
+        if kind == "M":
+            module.name = fields[1] or name_hint
+            attr_text = fields[2] if len(fields) > 2 else ""
+            pyparse.parse_module_attrs(attr_text, module.meta)
+        elif kind == "C":
+            current = Computation(name=fields[1], is_entry=fields[2] == "1")
+        elif kind == "E":
+            if current is not None:
+                module.add_computation(current)
+            current = None
+        elif kind == "I" and current is not None:
+            current.add(_build_op(fields))
+    if current is not None:
+        module.add_computation(current)
+    return module
+
+
+def _build_op(fields: list[str]) -> TraceOp:
+    from tpusim.ir import base_opcode
+
+    # I, name, root, shape, opcode, operands, attrs, literal
+    name, root, shape_text, opcode = fields[1], fields[2], fields[3], fields[4]
+    operands = tuple(o for o in fields[5].split(",") if o)
+    attr_text = fields[6] if len(fields) > 6 else ""
+    literal = fields[7] if len(fields) > 7 else ""
+
+    result = pyparse.parse_shape(shape_text)
+    attrs: dict[str, str] = {}
+    metadata: dict[str, str] = {}
+    if attr_text:
+        for tok in pyparse.split_top_level(attr_text):
+            if not tok:
+                continue
+            key, eq, val = tok.partition("=")
+            key = key.strip()
+            if not eq:
+                attrs[key] = ""
+            elif key == "metadata":
+                metadata = pyparse._parse_metadata(val.strip())
+            else:
+                attrs[key] = val.strip()
+    if opcode == "constant" and literal:
+        attrs.setdefault("literal", literal)
+
+    return TraceOp(
+        name=name,
+        opcode=opcode,
+        result=result,
+        operands=operands,
+        called=pyparse._collect_called(attrs),
+        fusion_kind=attrs.get("kind"),
+        collective=pyparse._maybe_collective(base_opcode(opcode), attrs),
+        attrs=attrs,
+        metadata=metadata,
+        is_root=root == "1",
+    )
+
+
+def parse_hlo_module_fast(text: str, name_hint: str = "module") -> ModuleTrace:
+    """Native parse when the library is built, Python otherwise."""
+    if native_available():
+        return parse_hlo_module_native(text, name_hint)
+    return pyparse.parse_hlo_module(text, name_hint)

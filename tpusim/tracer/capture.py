@@ -28,7 +28,6 @@ from typing import Any, Callable, Sequence
 
 from tpusim.ir import CommandKind, ModuleTrace, TraceCommand
 from tpusim.trace.format import TraceDir, save_trace
-from tpusim.trace.hlo_text import parse_hlo_module
 
 __all__ = ["Capture", "capture", "capture_to_dir", "measure_wall_time"]
 
@@ -60,7 +59,11 @@ class Capture:
     @property
     def module(self) -> ModuleTrace:
         if self._module is None:
-            self._module = parse_hlo_module(self.hlo_text, name_hint=self.name)
+            from tpusim.trace.native import parse_hlo_module_fast
+
+            self._module = parse_hlo_module_fast(
+                self.hlo_text, name_hint=self.name
+            )
             self._module.meta.update(self.meta)
         return self._module
 
