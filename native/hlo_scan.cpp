@@ -167,10 +167,13 @@ bool scan_instruction(const char* p, const char* end, Out& out) {
   out.field(opcode_start, opcode_end - opcode_start);
   out.field(operands);
   out.field(attrs, end - attrs);
-  const bool is_const =
-      (opcode_end - opcode_start == 8) &&
-      std::memcmp(opcode_start, "constant", 8) == 0;
-  if (is_const)
+  // constants need their literal; parameters their index (for fusion
+  // operand-to-param mapping) — both ride in the final field
+  const size_t op_len = opcode_end - opcode_start;
+  const bool keep_paren =
+      (op_len == 8 && std::memcmp(opcode_start, "constant", 8) == 0) ||
+      (op_len == 9 && std::memcmp(opcode_start, "parameter", 9) == 0);
+  if (keep_paren)
     out.field(p + 1, close - p - 1);
   else
     out.field("", 0);

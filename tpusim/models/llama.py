@@ -178,14 +178,54 @@ def _mlp(x, layer):
     return (gate * (x @ layer["w_up"])) @ layer["w_down"]
 
 
-def llama_forward(params, tokens, cfg: LlamaConfig):
-    """tokens [B,S] int32 → logits [B,S,vocab]."""
+def llama_forward(params, tokens, cfg: LlamaConfig, scan_layers: bool = False):
+    """tokens [B,S] int32 → logits [B,S,vocab].
+
+    ``scan_layers=True`` expects stacked layer params (leading layer dim,
+    see :func:`stack_layers`) and runs the decoder as a ``lax.scan`` — the
+    compact-HLO form used for large-model AOT captures (one layer body ×
+    trip count instead of 32 unrolled layers)."""
+    import jax
+
     x = params["embed"][tokens]
-    for layer in params["layers"]:
-        x = x + _attention(_rmsnorm(x, layer["attn_norm"], cfg.eps), layer, cfg)
-        x = x + _mlp(_rmsnorm(x, layer["mlp_norm"], cfg.eps), layer)
+    if scan_layers:
+        def body(h, layer):
+            h = h + _attention(
+                _rmsnorm(h, layer["attn_norm"], cfg.eps), layer, cfg
+            )
+            h = h + _mlp(_rmsnorm(h, layer["mlp_norm"], cfg.eps), layer)
+            return h, ()
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+    else:
+        for layer in params["layers"]:
+            x = x + _attention(
+                _rmsnorm(x, layer["attn_norm"], cfg.eps), layer, cfg
+            )
+            x = x + _mlp(_rmsnorm(x, layer["mlp_norm"], cfg.eps), layer)
     x = _rmsnorm(x, params["final_norm"], cfg.eps)
     return x @ params["embed"].T
+
+
+def stack_layers(cfg: LlamaConfig, leaf_fn):
+    """Build stacked-layer params: each layer leaf gains a leading [L] dim.
+    ``leaf_fn(name, shape)`` produces the leaf (array or ShapeDtypeStruct)."""
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    shapes = {
+        "attn_norm": (cfg.dim,),
+        "wq": (cfg.dim, cfg.dim),
+        "wk": (cfg.dim, kv_dim),
+        "wv": (cfg.dim, kv_dim),
+        "wo": (cfg.dim, cfg.dim),
+        "mlp_norm": (cfg.dim,),
+        "w_gate": (cfg.dim, cfg.ffn),
+        "w_up": (cfg.dim, cfg.ffn),
+        "w_down": (cfg.ffn, cfg.dim),
+    }
+    return {
+        name: leaf_fn(name, (cfg.layers,) + shape)
+        for name, shape in shapes.items()
+    }
 
 
 def make_llama_train_step(cfg: LlamaConfig, lr: float = 3e-4):
@@ -254,6 +294,95 @@ def build_llama_sharded(
         return llama_forward(params, tokens, cfg)
 
     return fwd, (params, tokens)
+
+
+def build_llama_aot(
+    preset: str = "7b",
+    batch: int = 8,
+    seq: int = 2048,
+    dp: int = 8,
+    tp: int = 8,
+    train: bool = True,
+):
+    """AOT (abstract) build for large-model capture: args are
+    ``jax.ShapeDtypeStruct`` with real GSPMD shardings, so a Llama-2-7B
+    pjit train step can be captured on virtual devices without ever
+    materializing 13GB of parameters — the "ahead-of-silicon" capture mode
+    from SURVEY.md §7's design mapping.  Layers are stacked and scanned,
+    keeping the HLO one-layer-sized."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = PRESETS[preset]
+    n = dp * tp
+    devs = np.array(jax.devices()[:n]).reshape(dp, tp)
+    mesh = Mesh(devs, ("dp", "tp"))
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    dt = jnp.dtype(cfg.dtype)
+    layer_spec = {
+        "attn_norm": (None,), "mlp_norm": (None,),
+        "wq": (None, None, "tp"), "wk": (None, None, "tp"),
+        "wv": (None, None, "tp"), "wo": (None, "tp", None),
+        "w_gate": (None, None, "tp"), "w_up": (None, None, "tp"),
+        "w_down": (None, "tp", None),
+    }
+
+    def leaf(name, shape):
+        spec = layer_spec[name]
+        spec = spec + (None,) * (len(shape) - len(spec))
+        return jax.ShapeDtypeStruct(shape, dt, sharding=ns(*spec[:len(shape)]))
+
+    params = {
+        "embed": jax.ShapeDtypeStruct(
+            (cfg.vocab, cfg.dim), dt, sharding=ns("tp", None)
+        ),
+        "final_norm": jax.ShapeDtypeStruct((cfg.dim,), dt, sharding=ns()),
+        "layers": stack_layers(cfg, leaf),
+    }
+    tok_sds = jax.ShapeDtypeStruct(
+        (batch, seq), jnp.int32, sharding=ns("dp")
+    )
+
+    if not train:
+        def fwd(params, tokens):
+            return llama_forward(params, tokens, cfg, scan_layers=True)
+
+        return fwd, (params, tok_sds)
+
+    def loss_fn(params, tokens, targets):
+        logits = llama_forward(
+            params, tokens, cfg, scan_layers=True
+        ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return nll.mean()
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        params = jax.tree_util.tree_map(
+            lambda p, g: (p - 3e-4 * g.astype(jnp.float32)).astype(p.dtype),
+            params, grads,
+        )
+        return loss, params
+
+    return step, (params, tok_sds, tok_sds)
+
+
+@register(
+    "llama7b_aot_v5p64",
+    description="Llama-2-7B pjit train step, AOT-captured on a dp8 x tp8 "
+    "64-device mesh (BASELINE config #5; ShapeDtypeStruct args)",
+    suite="models",
+    num_devices=64,
+    preset="7b", batch=8, seq=2048, dp=8, tp=8, train=True,
+)
+def build_llama7b_aot(**kw):
+    return build_llama_aot(**kw)
 
 
 @register(

@@ -186,29 +186,106 @@ def _region_bytes(comp: Computation, op: TraceOp) -> float:
     return 2.0 * region
 
 
-def _memory_bytes(comp: Computation, op: TraceOp) -> tuple[float, float]:
+def _fusion_param_region_bytes(
+    called: Computation,
+) -> dict[int, float]:
+    """For a fused computation, map parameter index → bytes actually read,
+    for parameters consumed ONLY through slice-like ops.  Scanned loop
+    bodies fuse ``dynamic-slice(stacked_weights, iv)`` — charging the full
+    stacked tensor would overstate a per-layer read by the layer count."""
+    consumers: dict[str, list[TraceOp]] = {}
+    for inner in called.ops:
+        for o in inner.operands:
+            consumers.setdefault(o, []).append(inner)
+    out: dict[int, float] = {}
+    for pop in called.ops:
+        if pop.opcode != "parameter":
+            continue
+        try:
+            idx = int(pop.attrs.get("param_index", ""))
+        except ValueError:
+            continue
+        cons = consumers.get(pop.name, [])
+        if cons and all(c.base in _REGION_OPS for c in cons):
+            # _region_bytes counts read+write of the moved region; the
+            # parameter side contributes the read half
+            out[idx] = float(sum(
+                _region_bytes(called, c) / 2.0 for c in cons
+            ))
+    return out
+
+
+_CHASE_THROUGH = ("bitcast", "bitcast-convert", "copy", "convert", "reshape")
+
+
+def _fusion_result_region_bytes(called: Computation) -> float | None:
+    """If a fusion's outputs are dynamic-update-slices into big carried
+    buffers (the activation-stash pattern in scanned training loops), the
+    written bytes are the update regions — not the full stacked buffers.
+    Returns the capped write size, or None when the root isn't DUS-shaped."""
+    root = called.root
+    elements = [root]
+    if root.base == "tuple":
+        elements = [
+            called.op(o) for o in root.operands if called.has_op(o)
+        ]
+    total = 0.0
+    for el in elements:
+        seen = 0
+        while el.base in _CHASE_THROUGH and el.operands and seen < 8:
+            if not called.has_op(el.operands[0]):
+                break
+            el = called.op(el.operands[0])
+            seen += 1
+        if el.base == "dynamic-update-slice" and len(el.operands) >= 2:
+            total += _leaf_shape(called, el.operands[1]).nbytes
+        elif el.opcode == "parameter":
+            continue  # pass-through, no write
+        else:
+            return None
+    return total
+
+
+def _memory_bytes(
+    comp: Computation,
+    op: TraceOp,
+    module: ModuleTrace | None = None,
+) -> tuple[float, float]:
     """(hbm_bytes, vmem_bytes) touched by one op: operands + result, split
     by the layout's memory space.  XLA:TPU marks vmem-pinned buffers with
     ``S(1)`` in the layout (observed on loop carries XLA keeps resident in
-    the 128MB vmem); default space 0 is HBM."""
+    the 128MB vmem); default space 0 is HBM.  For fusions, parameters that
+    are only sliced inside are charged at the sliced size."""
     hbm = 0.0
     vmem = 0.0
     seen = set()
 
-    def account(spec) -> None:
+    region_by_index: dict[int, float] = {}
+    result_cap: float | None = None
+    if op.base == "fusion" and op.called and module is not None:
+        if op.called[0] in module.computations:
+            called = module.computation(op.called[0])
+            region_by_index = _fusion_param_region_bytes(called)
+            result_cap = _fusion_result_region_bytes(called)
+
+    def account(spec, cap: float | None = None) -> None:
         nonlocal hbm, vmem
+        total = sum(l.nbytes for l in leaves_of(spec))
+        scale = 1.0
+        if cap is not None and total > 0:
+            scale = min(cap / total, 1.0)
         for leaf in leaves_of(spec):
             if leaf.memory_space != 0:
-                vmem += leaf.nbytes
+                vmem += leaf.nbytes * scale
             else:
-                hbm += leaf.nbytes
+                hbm += leaf.nbytes * scale
 
-    for name in op.operands:
+    for i, name in enumerate(op.operands):
         if name in seen or not comp.has_op(name):
             continue
         seen.add(name)
-        account(comp.op(name).result)
-    account(op.result)
+        account(comp.op(name).result, region_by_index.get(i))
+    account(op.result, result_cap)
     return hbm, vmem
 
 
@@ -321,10 +398,13 @@ class CostModel:
                         wnd *= int(d)
                 in_elems *= max(wnd, 1)
             c.flops = float(in_elems)
-            # cross-lane reductions run well below elementwise rate
-            c.compute_cycles = self._vpu_cycles(
-                c.flops * self.arch.vpu_reduce_slowdown, 0
+            # full cross-lane reductions run well below elementwise rate;
+            # windowed reductions are local and stream at elementwise rate
+            slowdown = (
+                1.0 if base == "reduce-window"
+                else self.arch.vpu_reduce_slowdown
             )
+            c.compute_cycles = self._vpu_cycles(c.flops * slowdown, 0)
             c.unit = Unit.VPU
         elif base == "transpose":
             c.unit = Unit.TRANSPOSE
@@ -401,7 +481,7 @@ class CostModel:
         # roofline over operands + outputs (the standard fusion assumption,
         # SURVEY.md §7), split by memory space: vmem-resident buffers
         # stream at vmem bandwidth, everything else at achieved HBM rate
-        c.hbm_bytes, c.vmem_bytes = _memory_bytes(comp, op)
+        c.hbm_bytes, c.vmem_bytes = _memory_bytes(comp, op, module)
         if base in _REGION_OPS:
             # slice-like ops touch only the moved region; XLA aliases the
             # untouched remainder in place (a full-buffer charge made a

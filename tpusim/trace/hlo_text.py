@@ -383,6 +383,9 @@ def parse_instruction(line: str) -> TraceOp | None:
     if opcode == "constant":
         # preserve the literal so loop analysis can resolve scalar bounds
         attrs.setdefault("literal", operand_str.strip())
+    elif opcode == "parameter":
+        # preserve the index so fusion costing can map operands to params
+        attrs.setdefault("param_index", operand_str.strip())
 
     op = TraceOp(
         name=m.group("name"),

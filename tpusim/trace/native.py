@@ -133,6 +133,8 @@ def _build_op(fields: list[str]) -> TraceOp:
                 attrs[key] = val.strip()
     if opcode == "constant" and literal:
         attrs.setdefault("literal", literal)
+    elif opcode == "parameter" and literal:
+        attrs.setdefault("param_index", literal)
 
     return TraceOp(
         name=name,
