@@ -1,0 +1,149 @@
+"""Tests for the auxiliary subsystems (SURVEY.md §5 parity): timeline
+export, failure detection, checkpoint/resume, interactive debugger."""
+
+import io
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.ir import CommandKind, PodTrace, TraceCommand
+from tpusim.sim.debugger import Debugger
+from tpusim.sim.driver import SimDriver
+from tpusim.sim.traceviz import timeline_to_chrome_trace, write_chrome_trace
+from tpusim.timing.config import SimConfig, overlay
+from tpusim.timing.engine import Engine
+from tpusim.trace.hlo_text import parse_hlo_module
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="module")
+def tiny_mlp():
+    return parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+
+
+def _pod(n_launches=1):
+    pod = PodTrace()
+    pod.modules["m"] = parse_hlo_module(
+        (FIXTURES / "tiny_mlp.hlo").read_text()
+    )
+    for _ in range(n_launches):
+        pod.device(0).commands.append(
+            TraceCommand(kind=CommandKind.KERNEL_LAUNCH, module="m")
+        )
+    return pod
+
+
+# -- timeline export --------------------------------------------------------
+
+def test_chrome_trace_export(tiny_mlp, tmp_path):
+    cfg = SimConfig()
+    res = Engine(cfg, record_timeline=True).run(tiny_mlp)
+    doc = timeline_to_chrome_trace(res, cfg.arch)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(events) == len(res.timeline) > 0
+    names = {e["args"]["op"] for e in events}
+    assert "dot.1" in names and "ar-start" in names
+    tids = {e["tid"] for e in events}
+    assert len(tids) >= 2  # MXU + ICI rows at minimum
+
+    out = tmp_path / "t.json"
+    write_chrome_trace(res, cfg.arch, out)
+    loaded = json.loads(out.read_text())
+    assert loaded["traceEvents"]
+
+
+# -- failure detection ------------------------------------------------------
+
+def test_orphan_and_unjoined_async_detected():
+    text = """
+HloModule bad, is_scheduled=true
+
+%r (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (x: f32[1024]) -> f32[1024] {
+  %x = f32[1024]{0} parameter(0)
+  %st = f32[1024]{0} all-reduce-start(%x), channel_id=1, replica_groups={{0,1}}, to_apply=%r
+  ROOT %dn = f32[1024]{0} all-reduce-done(%ghost)
+}
+"""
+    res = Engine(SimConfig()).run(parse_hlo_module(text))
+    assert res.orphan_async_joins == 1   # -done joined a nonexistent start
+    assert res.unjoined_async == 1       # -start never joined
+    assert res.stats_dict()["orphan_async_joins"] == 1
+
+
+def test_collective_rendezvous_mismatch_detected():
+    from tpusim.ir import CollectiveInfo
+
+    pod = PodTrace(meta={"num_devices": 2})
+    info = CollectiveInfo("all-reduce", replica_groups=((0, 1),))
+    pod.device(0).commands.append(TraceCommand(
+        kind=CommandKind.COLLECTIVE, device_id=0, nbytes=1024,
+        collective=info))
+    pod.device(0).commands.append(TraceCommand(
+        kind=CommandKind.COLLECTIVE, device_id=0, nbytes=1024,
+        collective=info))
+    pod.device(1).commands.append(TraceCommand(
+        kind=CommandKind.COLLECTIVE, device_id=1, nbytes=1024,
+        collective=info))
+    report = SimDriver(SimConfig()).run(pod)
+    assert report.stats.get("collective_rendezvous_mismatch") == 1
+
+
+# -- checkpoint / resume ----------------------------------------------------
+
+def test_checkpoint_resume_partition():
+    full = SimDriver(SimConfig()).run(_pod(4))
+    first = SimDriver(
+        overlay(SimConfig(), {"checkpoint_kernel": 2})
+    ).run(_pod(4))
+    rest = SimDriver(
+        overlay(SimConfig(), {"resume_kernel": 2})
+    ).run(_pod(4))
+    assert len(full.kernels) == 4
+    assert len(first.kernels) == 2
+    assert len(rest.kernels) == 2
+    assert first.stats.get("checkpoint_stop_kernel") == 2
+    # the two halves partition the work exactly
+    assert first.cycles + rest.cycles == pytest.approx(full.cycles)
+    assert (
+        first.totals.flops + rest.totals.flops
+        == pytest.approx(full.totals.flops)
+    )
+
+
+# -- debugger ---------------------------------------------------------------
+
+def _run_debugger(tiny_mlp, commands: str) -> str:
+    out = io.StringIO()
+    dbg = Debugger(tiny_mlp, SimConfig())
+    dbg.repl(io.StringIO(commands), out)
+    return out.getvalue()
+
+
+def test_debugger_step_and_continue(tiny_mlp):
+    text = _run_debugger(tiny_mlp, "s 3\nstats\nc\nq\n")
+    assert "tpusim debugger" in text
+    assert "dot.1" in text
+    assert "done:" in text and "cycles total" in text
+
+
+def test_debugger_breakpoint(tiny_mlp):
+    text = _run_debugger(tiny_mlp, "b ar-start\nc\np\nq\n")
+    assert "breakpoint: next op is ar-start" in text
+    # 'p' on the breakpoint op shows the collective detail
+    assert "all-reduce-start" in text
+    # the ops after the breakpoint were NOT yet executed
+    assert "dot.2" not in text.split("breakpoint:")[1].split("next op")[0]
+
+
+def test_debugger_list_and_help(tiny_mlp):
+    text = _run_debugger(tiny_mlp, "l 3\nbogus\nq\n")
+    assert "[   0]" in text
+    assert "commands:" in text

@@ -23,6 +23,10 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     overlays = list(args.config or [])
     if args.power:
         overlays.append({"power_enabled": True})
+    if args.resume_kernel:
+        overlays.append({"resume_kernel": args.resume_kernel})
+    if args.checkpoint_kernel:
+        overlays.append({"checkpoint_kernel": args.checkpoint_kernel})
     report = simulate_trace(args.trace, arch=args.arch, overlays=overlays)
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -69,6 +73,41 @@ def _cmd_info(args: argparse.Namespace) -> int:
     return 0
 
 
+def _pick_module(pod, name):
+    if name:
+        return pod.modules[name]
+    if not pod.modules:
+        raise KeyError("trace has no modules")
+    return pod.modules[sorted(pod.modules)[0]]
+
+
+def _cmd_debug(args: argparse.Namespace) -> int:
+    from tpusim.sim.debugger import Debugger
+    from tpusim.timing.config import load_config
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    mod = _pick_module(pod, args.module)
+    Debugger(mod, load_config(arch=args.arch)).repl()
+    return 0
+
+
+def _cmd_timeline(args: argparse.Namespace) -> int:
+    from tpusim.sim.traceviz import write_chrome_trace
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    mod = _pick_module(pod, args.module)
+    cfg = load_config(arch=args.arch)
+    res = Engine(cfg, record_timeline=True).run(mod)
+    write_chrome_trace(res, cfg.arch, args.out, process_name=mod.name)
+    print(f"chrome trace ({len(res.timeline)} events) written to {args.out}; "
+          f"open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
 def _cmd_tune(args: argparse.Namespace) -> int:
     from tpusim.harness.tuner import tune, write_overlay
 
@@ -108,6 +147,10 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--json", default=None, help="also write stats JSON here")
     ps.add_argument("--power", action="store_true",
                     help="enable the TPUWattch power model")
+    ps.add_argument("--resume-kernel", type=int, default=0,
+                    help="fast-forward the first N kernel launches")
+    ps.add_argument("--checkpoint-kernel", type=int, default=0,
+                    help="stop the replay after N kernel launches")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")
@@ -129,6 +172,23 @@ def main(argv: list[str] | None = None) -> int:
 
     pw = sub.add_parser("workloads", help="list registered workloads")
     pw.set_defaults(fn=_cmd_workloads)
+
+    pd = sub.add_parser(
+        "debug", help="single-step a trace module (gdb-style)"
+    )
+    pd.add_argument("trace")
+    pd.add_argument("--module", default=None)
+    pd.add_argument("--arch", default=None)
+    pd.set_defaults(fn=_cmd_debug)
+
+    pv = sub.add_parser(
+        "timeline", help="export a module's op timeline as Chrome trace JSON"
+    )
+    pv.add_argument("trace")
+    pv.add_argument("out")
+    pv.add_argument("--module", default=None)
+    pv.add_argument("--arch", default=None)
+    pv.set_defaults(fn=_cmd_timeline)
 
     args = p.parse_args(argv)
     try:

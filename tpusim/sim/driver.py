@@ -144,16 +144,28 @@ class SimDriver:
         ici_free = {d: 0.0 for d in device_ids}
         stream_free: dict[tuple[int, int], float] = defaultdict(float)
 
+        # checkpoint/resume at kernel granularity (per device, like the
+        # reference's per-kernel resume that fast-forwards finished work)
+        resume_k = max(cfg.resume_kernel, 0)
+        checkpoint_k = max(cfg.checkpoint_kernel, 0)
+
         for dev_id in device_ids:
             dev = pod.devices.get(dev_id)
             if dev is None:
                 continue
             coll_index = 0
+            kernel_index = 0
             for cmd in dev.commands:
                 key = (dev_id, cmd.stream_id)
                 ready = stream_free[key]
 
                 if cmd.kind == CommandKind.KERNEL_LAUNCH:
+                    kernel_index += 1
+                    if kernel_index <= resume_k:
+                        continue  # fast-forward already-simulated kernels
+                    if checkpoint_k and kernel_index > checkpoint_k:
+                        report.stats.set("checkpoint_stop_kernel", checkpoint_k)
+                        break
                     res = module_result(cmd.module)
                     start = max(ready, core_free[dev_id])
                     dur = res.cycles
@@ -206,6 +218,23 @@ class SimDriver:
                 max((v for (d, _), v in stream_free.items() if d == dev_id),
                     default=0.0),
             )
+
+        # failure detection: every participating device must have issued
+        # the same number of standalone collectives — ragged counts mean a
+        # device would hang waiting at a rendezvous (the NCCL-hang analog)
+        lengths = {k: len(v) for k, v in coll_ready.items()}
+        if lengths:
+            per_dev = [
+                sum(1 for c in pod.devices[d].commands
+                    if c.kind == CommandKind.COLLECTIVE)
+                for d in device_ids if d in pod.devices
+            ]
+            if len(set(per_dev)) > 1:
+                report.stats.set("collective_rendezvous_mismatch", 1)
+                report.stats.set(
+                    "collective_counts_per_device",
+                    ",".join(str(x) for x in per_dev),
+                )
 
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)

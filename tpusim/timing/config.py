@@ -175,6 +175,12 @@ class SimConfig:
     default_loop_trip_count: int = 1
     # power model on/off (reference: -power_simulation_enabled)
     power_enabled: bool = False
+    # checkpoint/resume at kernel granularity (reference:
+    # -checkpoint_kernel / -resume_kernel, abstract_hardware_model.cc:136):
+    # resume fast-forwards the first N kernel launches; checkpoint stops
+    # the replay after N launches and records the stop point
+    resume_kernel: int = 0
+    checkpoint_kernel: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,11 @@ class EngineResult:
     exposed_collective_cycles: float = 0.0  # cycles the core waited on ICI
     dma_cycles: float = 0.0
     exposed_dma_cycles: float = 0.0
+    # failure-detection counters (the deadlock_check analogue,
+    # gpu-sim.h:443): trace-corruption signals from the schedule walk
+    orphan_async_joins: int = 0     # -done with no matching -start
+    unjoined_async: int = 0         # -start never joined before comp end
+    unknown_trip_loops: int = 0     # while loops with unresolvable bounds
     unit_busy_cycles: dict[str, float] = field(
         default_factory=lambda: defaultdict(float)
     )
@@ -94,6 +99,9 @@ class EngineResult:
         self.exposed_collective_cycles += other.exposed_collective_cycles * times
         self.dma_cycles += other.dma_cycles * times
         self.exposed_dma_cycles += other.exposed_dma_cycles * times
+        self.orphan_async_joins += int(other.orphan_async_joins * times)
+        self.unjoined_async += int(other.unjoined_async * times)
+        self.unknown_trip_loops += int(other.unknown_trip_loops * times)
         for k, v in other.unit_busy_cycles.items():
             self.unit_busy_cycles[k] += v * times
         for k, v in other.opcode_cycles.items():
@@ -114,6 +122,9 @@ class EngineResult:
             "exposed_collective_cycles": self.exposed_collective_cycles,
             "dma_cycles": self.dma_cycles,
             "exposed_dma_cycles": self.exposed_dma_cycles,
+            "orphan_async_joins": self.orphan_async_joins,
+            "unjoined_async": self.unjoined_async,
+            "unknown_trip_loops": self.unknown_trip_loops,
             "mxu_utilization": self.mxu_utilization,
             "achieved_tflops": self.achieved_flops / 1e12,
             "hbm_gbps": self.hbm_gbps,
@@ -191,10 +202,10 @@ class Engine:
                 if trips <= 0:  # no backend_config: infer from the IV pattern
                     from tpusim.trace.loop_analysis import infer_trip_count
 
-                    trips = infer_trip_count(
-                        module, comp, op,
-                        self.config.default_loop_trip_count,
-                    )
+                    trips = infer_trip_count(module, comp, op, -1)
+                    if trips < 0:
+                        trips = self.config.default_loop_trip_count
+                        result.unknown_trip_loops += 1
                 sub = EngineResult()
                 body_end = self._run_computation(
                     module, module.computation(body_name), 0.0, coll, sub,
@@ -242,6 +253,8 @@ class Engine:
             # ---- async joins -------------------------------------------
             if op.is_async_done:
                 src = op.operands[0] if op.operands else None
+                if src not in pending:
+                    result.orphan_async_joins += 1
                 finish = pending.pop(src, t)
                 waited = max(0.0, finish - t)
                 if op.base in ("all-reduce", "all-gather", "reduce-scatter",
@@ -310,7 +323,10 @@ class Engine:
                 result.unit_busy_cycles[cost.unit.value] += dur
                 result.opcode_cycles[base] += dur
 
-        # drain: the program isn't done until pending transfers complete
+        # drain: the program isn't done until pending transfers complete;
+        # leftovers indicate a truncated/corrupt trace (async-start with no
+        # join) — surfaced like the reference's deadlock check
+        result.unjoined_async += len(pending)
         for finish in pending.values():
             t = max(t, finish)
         return t
