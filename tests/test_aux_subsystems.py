@@ -96,6 +96,53 @@ def test_collective_rendezvous_mismatch_detected():
     assert report.stats.get("collective_rendezvous_mismatch") == 1
 
 
+def test_no_orphan_join_when_overlap_disabled():
+    """A well-formed start/done pair must not count as orphaned when
+    overlap_collectives=False runs the start synchronously."""
+    text = """
+HloModule good, is_scheduled=true
+
+%r (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (x: f32[1024]) -> f32[1024] {
+  %x = f32[1024]{0} parameter(0)
+  %st = f32[1024]{0} all-reduce-start(%x), channel_id=1, replica_groups={{0,1}}, to_apply=%r
+  ROOT %dn = f32[1024]{0} all-reduce-done(%st)
+}
+"""
+    mod = parse_hlo_module(text)
+    for over in (True, False):
+        res = Engine(overlay(SimConfig(), {"overlap_collectives": over})).run(mod)
+        assert res.orphan_async_joins == 0, f"overlap={over}"
+        assert res.unjoined_async == 0, f"overlap={over}"
+
+
+def test_disjoint_replica_groups_not_flagged():
+    """Group (0,1) doing 2 collectives while disjoint group (2,3) does 1
+    is legal; a device with no collectives at all is also legal."""
+    from tpusim.ir import CollectiveInfo
+
+    pod = PodTrace(meta={"num_devices": 5})
+    g01 = CollectiveInfo("all-reduce", replica_groups=((0, 1),))
+    g23 = CollectiveInfo("all-reduce", replica_groups=((2, 3),))
+    for d in (0, 1):
+        for _ in range(2):
+            pod.device(d).commands.append(TraceCommand(
+                kind=CommandKind.COLLECTIVE, device_id=d, nbytes=64,
+                collective=g01))
+    for d in (2, 3):
+        pod.device(d).commands.append(TraceCommand(
+            kind=CommandKind.COLLECTIVE, device_id=d, nbytes=64,
+            collective=g23))
+    pod.device(4)  # issues nothing
+    report = SimDriver(SimConfig()).run(pod)
+    assert report.stats.get("collective_rendezvous_mismatch") is None
+
+
 # -- checkpoint / resume ----------------------------------------------------
 
 def test_checkpoint_resume_partition():
@@ -115,6 +162,40 @@ def test_checkpoint_resume_partition():
     assert (
         first.totals.flops + rest.totals.flops
         == pytest.approx(full.totals.flops)
+    )
+
+
+def test_checkpoint_resume_partition_with_memcpys():
+    """Memcpys in the stream must be billed to exactly one half: the H2D
+    before kernel 1 to the checkpoint run, the D2H after the last kernel
+    to the resume run."""
+    def pod():
+        p = _pod(0)
+        dev = p.device(0)
+        dev.commands.append(TraceCommand(
+            kind=CommandKind.MEMCPY_H2D, nbytes=1 << 20))
+        for _ in range(4):
+            dev.commands.append(TraceCommand(
+                kind=CommandKind.KERNEL_LAUNCH, module="m"))
+        dev.commands.append(TraceCommand(
+            kind=CommandKind.MEMCPY_D2H, nbytes=1 << 20))
+        return p
+
+    full = SimDriver(SimConfig()).run(pod())
+    first = SimDriver(
+        overlay(SimConfig(), {"checkpoint_kernel": 2})
+    ).run(pod())
+    rest = SimDriver(
+        overlay(SimConfig(), {"resume_kernel": 2})
+    ).run(pod())
+    assert len(first.kernels) == 2 and len(rest.kernels) == 2
+    assert full.memcpy_cycles > 0
+    # each memcpy simulated exactly once across the two halves
+    assert first.memcpy_cycles + rest.memcpy_cycles == pytest.approx(
+        full.memcpy_cycles
+    )
+    assert first.totals.flops + rest.totals.flops == pytest.approx(
+        full.totals.flops
     )
 
 

@@ -65,7 +65,7 @@ def test_unknown_module_raises():
         SimDriver(SimConfig()).run(pod)
 
 
-def test_steady_state_memcpy_shape():
+def test_steady_state_memcpy_shape(live_jax):
     """launches=N must yield one H2D (before first) and one D2H (after
     last), kernels in between."""
     import jax.numpy as jnp

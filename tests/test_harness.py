@@ -69,7 +69,7 @@ def test_scrape_run_dirs_and_csv(tmp_path):
 # -- runner (end-to-end over a real trace dir) ------------------------------
 
 @pytest.mark.slow
-def test_run_experiments_end_to_end(tmp_path):
+def test_run_experiments_end_to_end(tmp_path, live_jax):
     import jax.numpy as jnp
 
     from tpusim.tracer.capture import capture_to_dir
@@ -102,7 +102,7 @@ def test_run_experiments_end_to_end(tmp_path):
 # -- tuner ------------------------------------------------------------------
 
 @pytest.mark.slow
-def test_tuner_on_live_chip():
+def test_tuner_on_live_chip(live_jax):
     """The tuner must land near the calibrated preset on this chip."""
     import jax
 

@@ -105,7 +105,7 @@ ENTRY %main (a: f32[8]) -> f32[8] {
     assert infer_trip_count(mod, entry, entry.op("w"), default=5) == 5
 
 
-def test_real_scan_capture_roundtrip():
+def test_real_scan_capture_roundtrip(live_jax):
     """A jax.lax.scan captured on the live backend must get its length
     recovered (backend_config is absent on some backends)."""
     import jax

@@ -16,14 +16,14 @@ def test_registry():
         get_workload("nope")
 
 
-def test_workload_param_override():
+def test_workload_param_override(live_jax):
     wl = get_workload("matmul")
     fn, args = wl.build(m=64, n=32, k=16)
     a, b = args
     assert a.shape == (64, 16) and b.shape == (16, 32)
 
 
-def test_llama_tiny_forward_finite():
+def test_llama_tiny_forward_finite(live_jax):
     import jax.numpy as jnp
 
     wl = get_workload("llama_tiny")
@@ -33,7 +33,7 @@ def test_llama_tiny_forward_finite():
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
 
-def test_resnet50_param_count():
+def test_resnet50_param_count(live_jax):
     import jax
 
     from tpusim.models.resnet import init_resnet50

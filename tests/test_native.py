@@ -58,7 +58,7 @@ def test_parity_on_fixture():
     )
 
 
-def test_parity_on_live_capture():
+def test_parity_on_live_capture(live_jax):
     import jax.numpy as jnp
 
     from tpusim.tracer.capture import capture
@@ -111,15 +111,22 @@ def test_native_speedup_on_large_module():
         )
     big = text.split("ENTRY")[0] + "\n".join(clones) + "ENTRY" + text.split("ENTRY")[1]
 
-    t0 = time.perf_counter()
-    m_py = parse_hlo_module(big)
-    t_py = time.perf_counter() - t0
-    t0 = time.perf_counter()
+    m_py = parse_hlo_module(big)  # warm both paths before timing
     m_nat = parse_hlo_module_native(big)
-    t_nat = time.perf_counter() - t0
     assert len(m_py.computations) == len(m_nat.computations)
+
+    def best_of(fn, reps=3):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(big)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_py = best_of(parse_hlo_module)
+    t_nat = best_of(parse_hlo_module_native)
     # native is usually ~5-10x faster; allow slack for noisy CI machines
-    assert t_nat < t_py * 1.2
+    assert t_nat < t_py * 1.2, (t_nat, t_py)
 
 def test_native_robust_to_line_ending_variants():
     """CRLF, trailing whitespace, and %-less headers must parse the same

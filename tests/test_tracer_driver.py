@@ -19,7 +19,7 @@ from tpusim.trace.format import load_trace
 
 
 @pytest.fixture(scope="module")
-def matmul_capture():
+def matmul_capture(live_jax):
     import jax
     import jax.numpy as jnp
 
@@ -171,7 +171,7 @@ def test_simulate_trace_defaults_to_captured_arch(tmp_path, matmul_capture):
     assert report.cycles > 0
 
 
-def test_measure_wall_time_smoke():
+def test_measure_wall_time_smoke(live_jax):
     import jax.numpy as jnp
 
     from tpusim.tracer.capture import measure_wall_time

@@ -96,10 +96,17 @@ class Debugger:
                 continue
             cmd, args = parts[0], parts[1:]
 
+            def _int_arg(default: int) -> int:
+                try:
+                    return int(args[0]) if args else default
+                except ValueError:
+                    print(f"? not a count: {args[0]!r}", file=out)
+                    return 0
+
             if cmd == "q":
                 break
             elif cmd == "s":
-                n = int(args[0]) if args else 1
+                n = _int_arg(1)
                 for _ in range(n):
                     if not self._step_one(out):
                         break
@@ -121,7 +128,7 @@ class Debugger:
                 print(f"breakpoint #{len(self.breakpoints)} on "
                       f"{args[0]!r}", file=out)
             elif cmd == "l":
-                n = int(args[0]) if args else 5
+                n = _int_arg(5)
                 for i in range(self.pos, min(self.pos + n, len(self.ops))):
                     op = self.ops[i]
                     print(f"  [{i:4d}] {op.opcode:20s} {op.name}", file=out)

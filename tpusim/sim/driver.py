@@ -17,7 +17,7 @@ resources — the same semantics as the reference's stream windowing.
 from __future__ import annotations
 
 import time
-from collections import defaultdict
+from collections import Counter, defaultdict
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -159,13 +159,31 @@ class SimDriver:
                 key = (dev_id, cmd.stream_id)
                 ready = stream_free[key]
 
-                if cmd.kind == CommandKind.KERNEL_LAUNCH:
+                # kernel-granularity checkpoint/resume boundary: "after
+                # kernel K completes".  The k-th kernel is in the first
+                # half iff k <= K; a non-kernel command is in the first
+                # half iff fewer than K kernels precede it.  Both runs use
+                # the same rule, so first-half + second-half partitions the
+                # stream exactly (memcpys/collectives included).
+                is_kernel = cmd.kind == CommandKind.KERNEL_LAUNCH
+                if is_kernel:
                     kernel_index += 1
-                    if kernel_index <= resume_k:
-                        continue  # fast-forward already-simulated kernels
-                    if checkpoint_k and kernel_index > checkpoint_k:
-                        report.stats.set("checkpoint_stop_kernel", checkpoint_k)
-                        break
+                in_first_half = (
+                    kernel_index <= resume_k if is_kernel
+                    else kernel_index < resume_k
+                )
+                if resume_k and in_first_half:
+                    if cmd.kind == CommandKind.COLLECTIVE:
+                        coll_index += 1  # keep rendezvous indices aligned
+                    continue  # fast-forward already-simulated work
+                if checkpoint_k and (
+                    kernel_index > checkpoint_k if is_kernel
+                    else kernel_index >= checkpoint_k
+                ):
+                    report.stats.set("checkpoint_stop_kernel", checkpoint_k)
+                    break
+
+                if is_kernel:
                     res = module_result(cmd.module)
                     start = max(ready, core_free[dev_id])
                     dur = res.cycles
@@ -219,21 +237,44 @@ class SimDriver:
                     default=0.0),
             )
 
-        # failure detection: every participating device must have issued
-        # the same number of standalone collectives — ragged counts mean a
-        # device would hang waiting at a rendezvous (the NCCL-hang analog)
-        lengths = {k: len(v) for k, v in coll_ready.items()}
-        if lengths:
-            per_dev = [
-                sum(1 for c in pod.devices[d].commands
-                    if c.kind == CommandKind.COLLECTIVE)
-                for d in device_ids if d in pod.devices
-            ]
-            if len(set(per_dev)) > 1:
+        # failure detection: devices that share a replica group must issue
+        # the same number of collectives over that group — a ragged count
+        # means a device would hang waiting at a rendezvous (the NCCL-hang
+        # analog).  Disjoint groups and non-participating devices are fine.
+        if coll_ready:
+            per_dev_groups: dict[int, Counter] = {}
+            for d in device_ids:
+                dev = pod.devices.get(d)
+                if dev is None:
+                    continue
+                counts: Counter = Counter()
+                for cmd in dev.commands:
+                    if cmd.kind != CommandKind.COLLECTIVE or not cmd.collective:
+                        continue
+                    groups = cmd.collective.replica_groups or []
+                    mine = next(
+                        (tuple(g) for g in groups if d in g), None
+                    )
+                    if mine is None:
+                        # no groups recorded: all devices participate
+                        mine = tuple(device_ids)
+                    counts[mine] += 1
+                per_dev_groups[d] = counts
+            ragged: list[str] = []
+            for d, counts in per_dev_groups.items():
+                for grp, n in counts.items():
+                    for peer in grp:
+                        if peer == d or peer not in per_dev_groups:
+                            continue
+                        if per_dev_groups[peer].get(grp, 0) != n:
+                            ragged.append(
+                                f"dev{d}:{n}!=dev{peer}:"
+                                f"{per_dev_groups[peer].get(grp, 0)}@{grp}"
+                            )
+            if ragged:
                 report.stats.set("collective_rendezvous_mismatch", 1)
                 report.stats.set(
-                    "collective_counts_per_device",
-                    ",".join(str(x) for x in per_dev),
+                    "collective_counts_per_device", ";".join(sorted(set(ragged)))
                 )
 
         report.wall_seconds = time.perf_counter() - t_start

@@ -290,6 +290,10 @@ class Engine:
                     t = start + dur
                     ici_free = t
                     result.exposed_collective_cycles += dur
+                    if op.is_async_start:
+                        # already complete when the done-op arrives; register
+                        # so the join doesn't count as orphaned
+                        pending[op.name] = t
                 result.op_count += 1
                 continue
 
