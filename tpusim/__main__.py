@@ -27,6 +27,8 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         overlays.append({"resume_kernel": args.resume_kernel})
     if args.checkpoint_kernel:
         overlays.append({"checkpoint_kernel": args.checkpoint_kernel})
+    if args.network_mode:
+        overlays.append({"arch": {"ici": {"network_mode": args.network_mode}}})
     report = simulate_trace(args.trace, arch=args.arch, overlays=overlays)
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -151,6 +153,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="fast-forward the first N kernel launches")
     ps.add_argument("--checkpoint-kernel", type=int, default=0,
                     help="stop the replay after N kernel launches")
+    ps.add_argument("--network-mode", default=None,
+                    choices=["analytic", "detailed"],
+                    help="ICI model: closed-form schedules or per-packet "
+                         "torus network sim (the -network_mode equivalent)")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")

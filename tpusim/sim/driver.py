@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
 
-from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.detailed import make_collective_model
 from tpusim.ici.topology import Topology, torus_for
 from tpusim.ir import CommandKind, PodTrace, TraceCommand
 from tpusim.sim.stats import EXIT_SENTINEL, StatsRegistry
@@ -113,7 +113,7 @@ class SimDriver:
             len(pod.devices) or 1,
         )
         topo = self.topology or torus_for(n_devices, arch.name)
-        coll = CollectiveModel(topo, arch.ici)
+        coll = make_collective_model(topo, arch.ici)
         engine = Engine(cfg, topology=topo)
 
         report = SimReport(config_name=arch.name, num_devices=n_devices)

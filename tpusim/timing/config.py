@@ -51,6 +51,12 @@ class IciConfig:
     dcn_bandwidth: float = 25e9
     dcn_latency: float = 10e-6
     chips_per_slice: int = 0            # 0 = single slice
+    # network implementation (the -network_mode equivalent):
+    # "analytic" = closed-form schedule math (collectives.py);
+    # "detailed" = per-packet link contention sim (detailed.py / ici_net.cpp)
+    network_mode: str = "analytic"
+    # packet size the detailed network splits transfers into
+    packet_bytes: float = 16384.0
 
 
 @dataclass(frozen=True)

@@ -21,6 +21,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 
 from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.detailed import make_collective_model
 from tpusim.ici.topology import Topology, torus_for
 from tpusim.ir import Computation, ModuleTrace, TraceOp, Unit
 from tpusim.timing.config import SimConfig
@@ -162,7 +163,7 @@ class Engine:
     def run(self, module: ModuleTrace) -> EngineResult:
         """Simulate one execution of the module's entry computation."""
         topo = self._topology_for(module)
-        coll = CollectiveModel(topo, self.arch.ici)
+        coll = make_collective_model(topo, self.arch.ici)
         result = EngineResult()
         end = self._run_computation(
             module, module.entry, t0=0.0, coll=coll, result=result, depth=0

@@ -34,16 +34,28 @@ def _lib_path() -> Path:
     )
 
 
+def load_shared_lib() -> ctypes.CDLL | None:
+    """Open ``libtpusim_native.so`` (honoring ``TPUSIM_NO_NATIVE``) with no
+    symbol setup — shared by every native consumer; each declares and
+    version-checks its own entry points."""
+    path = _lib_path()
+    if not path.exists() or os.environ.get("TPUSIM_NO_NATIVE"):
+        return None
+    try:
+        return ctypes.CDLL(str(path))
+    except OSError:
+        return None
+
+
 def _load() -> ctypes.CDLL | None:
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    path = _lib_path()
-    if not path.exists() or os.environ.get("TPUSIM_NO_NATIVE"):
+    lib = load_shared_lib()
+    if lib is None:
         return None
     try:
-        lib = ctypes.CDLL(str(path))
         lib.hlo_scan.restype = ctypes.POINTER(ctypes.c_char)
         lib.hlo_scan.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64,
@@ -54,7 +66,7 @@ def _load() -> ctypes.CDLL | None:
         if lib.hlo_scan_abi_version() != 1:
             return None
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
         return None
     return _LIB
 
