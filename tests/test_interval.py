@@ -1,0 +1,96 @@
+"""Tests for interval-sampled stats (`tpusim/sim/interval.py`) — the
+``gpu_stat_sample_freq`` / visualizer-log parity slot (SURVEY.md §5)."""
+
+from pathlib import Path
+
+import pytest
+
+from tpusim.sim.interval import (
+    IntervalSample,
+    read_interval_log,
+    render_text_lanes,
+    sample_intervals,
+    write_interval_log,
+)
+from tpusim.timing.config import SimConfig
+from tpusim.timing.engine import Engine, EngineResult, TimelineEvent
+from tpusim.trace.hlo_text import parse_hlo_module
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _result(events):
+    res = EngineResult()
+    res.timeline = [TimelineEvent(*e) for e in events]
+    return res
+
+
+def test_event_split_across_windows():
+    res = _result([("a", "dot", "mxu", 50.0, 250.0)])
+    samples = sample_intervals(res, 100.0)
+    assert len(samples) == 3
+    assert samples[0].unit_busy["mxu"] == pytest.approx(50.0)
+    assert samples[1].unit_busy["mxu"] == pytest.approx(100.0)
+    assert samples[2].unit_busy["mxu"] == pytest.approx(50.0)
+    # op counted once, in its starting window
+    assert [s.op_count for s in samples] == [1, 0, 0]
+    assert samples[1].utilization("mxu") == pytest.approx(1.0)
+
+
+def test_busy_conservation():
+    """Total bucketed busy time must equal the sum of event durations."""
+    res = _result([
+        ("a", "dot", "mxu", 0.0, 333.0),
+        ("b", "add", "vpu", 100.0, 450.0),
+        ("c", "ar", "ici", 50.0, 60.0),
+    ])
+    samples = sample_intervals(res, 128.0)
+    tot = {}
+    for s in samples:
+        for u, b in s.unit_busy.items():
+            tot[u] = tot.get(u, 0.0) + b
+    assert tot["mxu"] == pytest.approx(333.0)
+    assert tot["vpu"] == pytest.approx(350.0)
+    assert tot["ici"] == pytest.approx(10.0)
+
+
+def test_log_roundtrip(tmp_path):
+    res = _result([("a", "dot", "mxu", 0.0, 150.0)])
+    samples = sample_intervals(res, 100.0)
+    path = tmp_path / "ivl.jsonl.gz"
+    write_interval_log(samples, path, meta={"module": "m"})
+    header, loaded = read_interval_log(path)
+    assert header["module"] == "m"
+    assert len(loaded) == len(samples)
+    assert loaded[0].unit_busy == samples[0].unit_busy
+    with pytest.raises(ValueError):
+        import gzip
+
+        bad = tmp_path / "bad.gz"
+        with gzip.open(bad, "wt") as f:
+            f.write('{"nope": 1}\n')
+        read_interval_log(bad)
+
+
+def test_render_text_lanes_resamples():
+    res = _result(
+        [("a", "dot", "mxu", float(i * 10), float(i * 10 + 5))
+         for i in range(200)]
+    )
+    samples = sample_intervals(res, 10.0)
+    text = render_text_lanes(samples, width=40)
+    lane = [l for l in text.splitlines() if l.strip().startswith("mxu")][0]
+    assert lane.count("|") == 2
+    assert len(lane.split("|")[1]) == 40
+
+
+def test_engine_timeline_to_intervals(fixtures_dir):
+    mod = parse_hlo_module((fixtures_dir / "tiny_mlp.hlo").read_text())
+    cfg = SimConfig()
+    res = Engine(cfg, record_timeline=True).run(mod)
+    samples = sample_intervals(res, cfg.stat_sample_cycles)
+    assert samples
+    busy = sum(s.unit_busy.get("mxu", 0.0) for s in samples)
+    assert busy == pytest.approx(
+        res.unit_busy_cycles["mxu"], rel=1e-6
+    )

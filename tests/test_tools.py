@@ -1,0 +1,152 @@
+"""Tests for the auxiliary analysis tools (`tpusim/tools/`) — the
+bbv_tool / occupancy_calc_tool / silicon_checkpoint_tool parity slots
+(`util/tracer_nvbit/others/`)."""
+
+from pathlib import Path
+
+import pytest
+
+from tests.conftest import run_in_cpu_mesh
+from tpusim.timing.config import load_config
+from tpusim.tools.bbv import compute_bbv, write_simpoint_bb
+from tpusim.tools.occupancy import occupancy_report
+from tpusim.trace.hlo_text import parse_hlo_module
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="module")
+def tiny_mlp():
+    return parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+
+
+# -- bbv --------------------------------------------------------------------
+
+def test_bbv_vectors_cover_all_ops(tiny_mlp):
+    res = compute_bbv(tiny_mlp, interval_ops=4)
+    total = sum(sum(v.values()) for v in res.vectors)
+    assert total > 0
+    # every interval except possibly the last is exactly full
+    for v in res.vectors[:-1]:
+        assert sum(v.values()) == 4
+    assert sum(res.vectors[-1].values()) <= 4
+    # dot must appear as a dimension
+    assert "dot" in res.dims
+
+
+def test_bbv_while_bodies_repeat():
+    """A while with trip count K must contribute K copies of its body —
+    the phase behavior SimPoint clusters on."""
+    text = """
+HloModule loopy, is_scheduled=true
+
+%body (p: (f32[64,64], s32[])) -> (f32[64,64], s32[]) {
+  %p = (f32[64,64]{1,0}, s32[]) parameter(0)
+  %x = f32[64,64]{1,0} get-tuple-element(%p), index=0
+  %i = s32[] get-tuple-element(%p), index=1
+  %d = f32[64,64]{1,0} dot(%x, %x), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %one = s32[] constant(1)
+  %ni = s32[] add(%i, %one)
+  ROOT %t = (f32[64,64]{1,0}, s32[]) tuple(%d, %ni)
+}
+
+%cond (p: (f32[64,64], s32[])) -> pred[] {
+  %p = (f32[64,64]{1,0}, s32[]) parameter(0)
+  %i = s32[] get-tuple-element(%p), index=1
+  %lim = s32[] constant(7)
+  ROOT %lt = pred[] compare(%i, %lim), direction=LT
+}
+
+ENTRY %main (a: f32[64,64]) -> (f32[64,64], s32[]) {
+  %a = f32[64,64]{1,0} parameter(0)
+  %z = s32[] constant(0)
+  %t0 = (f32[64,64]{1,0}, s32[]) tuple(%a, %z)
+  ROOT %w = (f32[64,64]{1,0}, s32[]) while(%t0), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"7"}}
+}
+"""
+    mod = parse_hlo_module(text)
+    res = compute_bbv(mod, interval_ops=100000)
+    dots = sum(v.get(res.dims["dot"], 0) for v in res.vectors)
+    assert dots == 7
+
+
+def test_simpoint_format(tmp_path, tiny_mlp):
+    res = compute_bbv(tiny_mlp, interval_ops=4)
+    out = tmp_path / "trace.bb"
+    write_simpoint_bb(res, out)
+    lines = out.read_text().splitlines()
+    assert len(lines) == res.num_intervals
+    for line in lines:
+        assert line.startswith("T:")
+        for part in line[1:].split():
+            _, dim, count = part.split(":")
+            assert int(dim) >= 1 and int(count) >= 1
+
+
+# -- occupancy --------------------------------------------------------------
+
+def test_occupancy_full_tiles(tiny_mlp):
+    cfg = load_config(arch="v5p")
+    report = occupancy_report(tiny_mlp, cfg.arch)
+    assert report.ops, "fixture has dots"
+    for o in report.ops:
+        assert 0 < o.tile_fill <= 1.0
+        assert 0 < o.row_fill <= 1.0
+        assert 0 < o.pipeline_eff < 1.0
+        assert 0 < o.mxu_occupancy <= 1.0
+
+
+def test_occupancy_flags_skinny_matmul():
+    """A K=32 matmul fills 25% of a 128-row array; a 1-row M is
+    pipeline-starved.  The calculator must rank them below a full tile."""
+    text = """
+HloModule skinny, is_scheduled=true
+
+ENTRY %main (a: f32[1,32], b: f32[32,256], c: bf16[512,128], d: bf16[128,128]) -> f32[1,256] {
+  %a = f32[1,32]{1,0} parameter(0)
+  %b = f32[32,256]{1,0} parameter(1)
+  %c = bf16[512,128]{1,0} parameter(2)
+  %d = bf16[128,128]{1,0} parameter(3)
+  %big = bf16[512,128]{1,0} dot(%c, %d), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %sk = f32[1,256]{1,0} dot(%a, %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+    mod = parse_hlo_module(text)
+    cfg = load_config(arch="v5p")
+    report = occupancy_report(mod, cfg.arch)
+    by_name = {o.name: o for o in report.ops}
+    assert by_name["sk"].tile_fill == pytest.approx(32 / 128 * 256 / 256)
+    assert by_name["sk"].row_fill == pytest.approx(1 / 8)
+    assert by_name["big"].mxu_occupancy > by_name["sk"].mxu_occupancy
+    assert report.worst[0].name == "sk"
+
+
+# -- buffer snapshots -------------------------------------------------------
+
+SNAPSHOT_SCRIPT = r"""
+import numpy as np
+import jax.numpy as jnp
+from tpusim.tracer.capture import snapshot_buffers
+
+def f(x):
+    return x * 2.0, x.sum()
+
+paths = snapshot_buffers(
+    f, jnp.arange(8.0), out_dir=OUT, launches=2
+)
+assert len(paths) == 4, paths
+a = np.load(paths[0])
+assert np.allclose(a, np.arange(8.0) * 2.0)
+s = np.load(paths[1])
+assert float(s) == 28.0
+print("SNAP_OK")
+"""
+
+
+@pytest.mark.slow
+def test_snapshot_buffers(tmp_path):
+    out = run_in_cpu_mesh(
+        SNAPSHOT_SCRIPT.replace("OUT", repr(str(tmp_path / "ckpt"))),
+        n_devices=1,
+    )
+    assert "SNAP_OK" in out

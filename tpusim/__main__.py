@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from pathlib import Path
 
 
 def _cmd_simulate(args: argparse.Namespace) -> int:
@@ -48,6 +49,15 @@ def _cmd_capture(args: argparse.Namespace) -> int:
     capture_to_dir(
         args.out, fn, *wl_args, name=wl.name, launches=args.launches
     )
+    if args.snapshot:
+        from tpusim.tracer.capture import snapshot_buffers
+
+        paths = snapshot_buffers(
+            fn, *wl_args,
+            out_dir=Path(args.out) / "checkpoint_files",
+            launches=args.launches,
+        )
+        print(f"{len(paths)} buffer snapshots in {args.out}/checkpoint_files")
     print(f"trace written to {args.out}")
     return 0
 
@@ -110,6 +120,36 @@ def _cmd_timeline(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_aerial(args: argparse.Namespace) -> int:
+    from tpusim.sim.interval import (
+        render_text_lanes, sample_intervals, write_interval_log,
+    )
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    mod = _pick_module(pod, args.module)
+    cfg = load_config(arch=args.arch)
+    cap = 2_000_000
+    res = Engine(cfg, record_timeline=True, max_timeline_events=cap).run(mod)
+    if len(res.timeline) >= cap:
+        print(f"warning: timeline capped at {cap} events; "
+              "the view covers only the first part of the run",
+              file=sys.stderr)
+    sample = args.sample or cfg.stat_sample_cycles
+    samples = sample_intervals(res, sample)
+    if args.gz:
+        write_interval_log(
+            samples, args.gz,
+            meta={"module": mod.name, "arch": cfg.arch.name,
+                  "sample_cycles": sample},
+        )
+        print(f"interval log written to {args.gz}")
+    print(render_text_lanes(samples), end="")
+    return 0
+
+
 def _cmd_tune(args: argparse.Namespace) -> int:
     from tpusim.harness.tuner import tune, write_overlay
 
@@ -125,6 +165,35 @@ def _cmd_tune(args: argparse.Namespace) -> int:
     if args.out:
         write_overlay(result, args.out)
         print(f"overlay written to {args.out}")
+    return 0
+
+
+def _cmd_bbv(args: argparse.Namespace) -> int:
+    from tpusim.tools.bbv import compute_bbv, write_simpoint_bb
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    mod = _pick_module(pod, args.module)
+    res = compute_bbv(mod, interval_ops=args.interval)
+    print(f"{res.num_intervals} intervals x {args.interval} ops, "
+          f"{len(res.dims)} opcode dims")
+    if args.out:
+        write_simpoint_bb(res, args.out)
+        print(f"SimPoint frequency vectors written to {args.out}")
+    return 0
+
+
+def _cmd_occupancy(args: argparse.Namespace) -> int:
+    from tpusim.timing.config import load_config
+    from tpusim.tools.occupancy import occupancy_report
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(args.trace)
+    mod = _pick_module(pod, args.module)
+    cfg = load_config(arch=args.arch)
+    report = occupancy_report(mod, cfg.arch)
+    for line in report.summary_lines(limit=args.limit):
+        print(line)
     return 0
 
 
@@ -163,6 +232,9 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("workload")
     pc.add_argument("out")
     pc.add_argument("--launches", type=int, default=1)
+    pc.add_argument("--snapshot", action="store_true",
+                    help="also dump every output buffer per launch to "
+                         "<out>/checkpoint_files/ (silicon checkpoints)")
     pc.set_defaults(fn=_cmd_capture)
 
     pi = sub.add_parser("info", help="describe a stored trace")
@@ -196,10 +268,47 @@ def main(argv: list[str] | None = None) -> int:
     pv.add_argument("--arch", default=None)
     pv.set_defaults(fn=_cmd_timeline)
 
+    pa = sub.add_parser(
+        "aerial",
+        help="interval-sampled unit-utilization time series "
+             "(the AerialVision-style time-lapse view)",
+    )
+    pa.add_argument("trace")
+    pa.add_argument("--module", default=None)
+    pa.add_argument("--arch", default=None)
+    pa.add_argument("--sample", type=float, default=0,
+                    help="window size in cycles (default: stat_sample_cycles)")
+    pa.add_argument("--gz", default=None,
+                    help="also write the gzip'd JSONL interval log here")
+    pa.set_defaults(fn=_cmd_aerial)
+
+    pb = sub.add_parser(
+        "bbv",
+        help="per-interval opcode vectors for SimPoint phase sampling "
+             "(the bbv_tool equivalent)",
+    )
+    pb.add_argument("trace")
+    pb.add_argument("--module", default=None)
+    pb.add_argument("--interval", type=int, default=1000)
+    pb.add_argument("--out", default=None,
+                    help="write SimPoint .bb frequency vectors here")
+    pb.set_defaults(fn=_cmd_bbv)
+
+    po = sub.add_parser(
+        "occupancy",
+        help="MXU tile / vmem occupancy per matmul-shaped op "
+             "(the occupancy_calc_tool equivalent)",
+    )
+    po.add_argument("trace")
+    po.add_argument("--module", default=None)
+    po.add_argument("--arch", default=None)
+    po.add_argument("--limit", type=int, default=10)
+    po.set_defaults(fn=_cmd_occupancy)
+
     args = p.parse_args(argv)
     try:
         return args.fn(args)
-    except (KeyError, FileNotFoundError) as e:
+    except (KeyError, FileNotFoundError, ValueError) as e:
         print(f"tpusim: error: {e}", file=sys.stderr)
         return 2
 

@@ -1,0 +1,6 @@
+"""Standalone analysis tools — the ``util/tracer_nvbit/others/`` parity
+slot: the reference ships auxiliary NVBit tools alongside its tracer
+(bbv_tool for SimPoint basic-block vectors, occupancy_calc_tool,
+silicon_checkpoint_tool); tpusim ships the HLO-level equivalents
+(:mod:`tpusim.tools.bbv`, :mod:`tpusim.tools.occupancy`, and buffer
+snapshots in :mod:`tpusim.tracer.capture`)."""

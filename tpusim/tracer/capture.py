@@ -199,6 +199,59 @@ def capture_to_dir(
     )
 
 
+def snapshot_buffers(
+    fn: Callable,
+    *args: Any,
+    out_dir: str | Path,
+    launches: int = 1,
+    **kwargs: Any,
+) -> list[Path]:
+    """Run the program on the live backend and dump every output buffer to
+    ``.npy`` files after each launch — the silicon-side state checkpoint
+    (rebuild of silicon_checkpoint_tool, ``util/tracer_nvbit/others/
+    silicon_checkpoint_tool/checkpoint/checkpoint.cu:196-290``, which
+    snapshots all live cuMemAlloc regions after each kernel).  Snapshots
+    are the functional ground truth a divergence hunt diffs sim-side
+    functional state against."""
+    import shutil
+
+    import jax
+    import numpy as np
+
+    if any(
+        isinstance(leaf, jax.ShapeDtypeStruct)
+        for leaf in jax.tree_util.tree_leaves((args, kwargs))
+    ):
+        raise ValueError(
+            "snapshot_buffers needs concrete inputs; this workload has "
+            "abstract ShapeDtypeStruct args (AOT capture) — skip --snapshot"
+        )
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    out_root = Path(out_dir)
+    out_root.mkdir(parents=True, exist_ok=True)
+    paths: list[Path] = []
+    # a jitted program is pure, so every launch with the same inputs
+    # produces identical buffers: execute once, replicate per launch
+    out = jitted(*args, **kwargs)
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if hasattr(l, "dtype")]
+    for j, leaf in enumerate(leaves):
+        path = out_root / f"launch0_buf{j}.npy"
+        np.save(path, np.asarray(jax.device_get(leaf)))
+        paths.append(path)
+    for i in range(1, launches):
+        for j in range(len(leaves)):
+            src = out_root / f"launch0_buf{j}.npy"
+            dst = out_root / f"launch{i}_buf{j}.npy"
+            dst.unlink(missing_ok=True)
+            try:
+                os.link(src, dst)
+            except OSError:
+                shutil.copyfile(src, dst)
+            paths.append(dst)
+    return paths
+
+
 def measure_wall_time(
     fn: Callable,
     *args: Any,
