@@ -85,6 +85,19 @@ def main() -> int:
         "device": dev.device_kind,
         "workloads": len(points),
     }
+
+    import os
+
+    report_dir = os.environ.get("TPUSIM_BENCH_REPORT")
+    if report_dir:
+        try:
+            from tpusim.harness.plots import write_correlation_report
+
+            path = write_correlation_report(points, report_dir)
+            log(f"bench: correlation report written to {path}")
+        except Exception as e:  # cosmetic step must not eat the result
+            log(f"bench: report FAILED: {type(e).__name__}: {e}")
+
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Golden-stat regression check — the CI tier the reference runs as
+``travis.sh`` + ``Jenkinsfile`` (simulate pre-recorded traces, then gate on
+scraped stats; ``util/job_launching/get_stats.py`` success sentinel).
+
+Simulates every fixture trace under a matrix of configs and compares the
+scraped stats against ``ci/golden/<name>.json``.  The simulator is
+deterministic, so the default comparison is exact for counter stats and
+tight-relative for derived floats; any diff means the timing model changed
+— rerun with ``--update`` when the change is intended.
+
+Usage:
+    python ci/check_golden.py            # check
+    python ci/check_golden.py --update   # regenerate goldens
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+GOLDEN_DIR = REPO / "ci" / "golden"
+FIXTURES = REPO / "tests" / "fixtures" / "traces"
+
+#: (fixture dir name, arch, overlay dicts) — the QV100/RTX2060/RTX3070
+#: config-matrix idea at TPU scale
+MATRIX = [
+    ("matmul_512", "v5e", []),
+    ("matmul_512", "v5p", []),
+    ("llama_tiny_tp2dp2", "v5p", []),
+    ("llama_tiny_tp2dp2", "v5p",
+     [{"arch": {"ici": {"network_mode": "detailed"}}}]),
+    ("llama_tiny_tp2dp2", "v6e", [{"power_enabled": True}]),
+]
+
+#: host-dependent stats excluded from comparison
+VOLATILE = {"simulation_rate_kops", "wall_seconds"}
+#: relative tolerance for derived float stats
+RTOL = 1e-9
+
+
+def run_matrix() -> dict[str, dict[str, float]]:
+    from tpusim.sim.driver import simulate_trace
+
+    out: dict[str, dict[str, float]] = {}
+    for fixture, arch, overlays in MATRIX:
+        name = f"{fixture}__{arch}" + (
+            "__" + "_".join(
+                sorted(str(k) for o in overlays for k in o)
+            ) if overlays else ""
+        )
+        report = simulate_trace(
+            FIXTURES / fixture, arch=arch, overlays=list(overlays)
+        )
+        stats = {
+            k: v for k, v in json.loads(report.stats.to_json()).items()
+            if k not in VOLATILE
+        }
+        out[name] = stats
+    return out
+
+
+def compare(
+    got: dict[str, dict[str, float]],
+) -> list[str]:
+    errors: list[str] = []
+    for name, stats in got.items():
+        path = GOLDEN_DIR / f"{name}.json"
+        if not path.exists():
+            errors.append(f"{name}: no golden file {path} (run --update)")
+            continue
+        golden = json.loads(path.read_text())
+        for key in sorted(set(golden) | set(stats)):
+            if key in VOLATILE:
+                continue
+            if key not in golden:
+                errors.append(f"{name}: NEW stat {key} = {stats[key]}")
+                continue
+            if key not in stats:
+                errors.append(f"{name}: MISSING stat {key}")
+                continue
+            g, s = golden[key], stats[key]
+            if isinstance(g, (int, float)) and isinstance(s, (int, float)):
+                tol = RTOL * max(abs(g), abs(s), 1e-30)
+                if abs(g - s) > tol:
+                    errors.append(
+                        f"{name}: {key} changed {g!r} -> {s!r}"
+                    )
+            elif g != s:
+                errors.append(f"{name}: {key} changed {g!r} -> {s!r}")
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite ci/golden/ from the current model")
+    args = ap.parse_args(argv)
+
+    got = run_matrix()
+    if args.update:
+        GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+        for name, stats in got.items():
+            (GOLDEN_DIR / f"{name}.json").write_text(
+                json.dumps(stats, indent=1, sort_keys=True) + "\n"
+            )
+        print(f"updated {len(got)} golden files in {GOLDEN_DIR}")
+        return 0
+
+    errors = compare(got)
+    for e in errors:
+        print(f"GOLDEN MISMATCH: {e}")
+    if errors:
+        print(f"ci/check_golden: FAILED ({len(errors)} diffs)")
+        return 1
+    print(f"ci/check_golden: OK ({len(got)} configs, all stats match)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

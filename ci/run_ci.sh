@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CI pipeline — the travis.sh / Jenkinsfile equivalent (reference:
+# travis.sh:1-24 builds the sim, downloads prebuilt traces, simulates, and
+# gates on the functional-test monitor; Jenkinsfile:26-52 adds the
+# multi-config matrix).  tpusim's tiers:
+#
+#   1. build   — native components (the `make` of accel-sim.out)
+#   2. unit    — pytest fast tier (the improvement over the reference's
+#                CI-only testing, SURVEY.md §4)
+#   3. golden  — simulate committed fixture traces across a config matrix,
+#                diff every stat against ci/golden/ (the prebuilt-trace
+#                regression sims)
+#   4. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#                (opt-in: CI_SLOW=1)
+#
+# Usage:  bash ci/run_ci.sh            # tiers 1-3
+#         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== [1/4] build native ==="
+make -C native
+
+echo "=== [2/4] unit tests (fast tier) ==="
+python -m pytest tests/ -q -m "not slow"
+
+echo "=== [3/4] golden-stat regression sims ==="
+python ci/check_golden.py
+
+if [[ "${CI_SLOW:-0}" == "1" ]]; then
+  echo "=== [4/4] slow tier (SPMD subprocess meshes) ==="
+  python -m pytest tests/ -q -m slow
+else
+  echo "=== [4/4] slow tier skipped (set CI_SLOW=1) ==="
+fi
+
+echo "CI: all tiers green"

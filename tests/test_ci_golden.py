@@ -1,0 +1,43 @@
+"""The golden-stat CI gate must pass against the committed goldens and
+catch an injected model change (ci/check_golden.py — the travis.sh /
+Jenkinsfile parity tier)."""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "ci"))
+
+import pytest  # noqa: E402
+
+import check_golden  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def matrix_results():
+    return check_golden.run_matrix()
+
+
+def test_goldens_match_current_model(matrix_results):
+    errors = check_golden.compare(matrix_results)
+    assert not errors, "\n".join(errors)
+
+
+def test_golden_catches_model_change(matrix_results):
+    got = dict(matrix_results)
+    name = next(iter(got))
+    got[name] = dict(got[name])
+    got[name]["sim_cycle"] = got[name].get("sim_cycle", 0) + 12345
+    errors = check_golden.compare(got)
+    assert any("sim_cycle" in e for e in errors)
+
+
+def test_golden_files_are_committed():
+    goldens = list((REPO / "ci" / "golden").glob("*.json"))
+    assert len(goldens) == len(check_golden.MATRIX)
+    for g in goldens:
+        data = json.loads(g.read_text())
+        assert "sim_cycle" in data
+        for vol in check_golden.VOLATILE:
+            assert vol not in data

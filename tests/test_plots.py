@@ -1,0 +1,49 @@
+"""Tests for the correlation plot/report layer (`tpusim/harness/plots.py`
+— the plot-correlation.py / correl-html parity slot)."""
+
+import math
+
+import pytest
+
+from tpusim.harness.correlate import CorrelationPoint
+from tpusim.harness.plots import correlation_stats, write_correlation_report
+
+
+def _points():
+    return [
+        CorrelationPoint("mxu", 1.00e-3, 1.05e-3, 1e6, 1e12, 1e9),
+        CorrelationPoint("hbm", 2.10e-4, 2.00e-4, 2e5, 1e9, 4e9),
+        CorrelationPoint("mix", 5.30e-5, 5.00e-5, 5e4, 1e10, 1e8),
+    ]
+
+
+def test_correlation_stats():
+    stats = correlation_stats(_points())
+    assert stats["n"] == 3
+    errs = [abs(100 * (p.sim_seconds - p.real_seconds) / p.real_seconds)
+            for p in _points()]
+    assert stats["mean_abs_error_pct"] == pytest.approx(sum(errs) / 3)
+    assert stats["max_abs_error_pct"] == pytest.approx(max(errs))
+    assert 0.99 < stats["log_correlation"] <= 1.0
+
+
+def test_correlation_stats_empty_and_degenerate():
+    assert correlation_stats([]) == {"n": 0}
+    bad = [CorrelationPoint("z", 1e-3, 0.0, 1.0, 1.0, 1.0)]
+    assert correlation_stats(bad) == {"n": 0}
+    one = correlation_stats([_points()[0]])
+    assert one["n"] == 1
+    assert math.isfinite(one["mean_abs_error_pct"])
+
+
+def test_write_report(tmp_path):
+    path = write_correlation_report(_points(), tmp_path)
+    assert path.name == "correl.html"
+    text = path.read_text()
+    assert "data:image/png;base64," in text
+    assert "mxu" in text and "hbm" in text
+    assert (tmp_path / "correl.png").stat().st_size > 1000
+    # worst-error row first IN THE TABLE (hbm: |5.0%| > mxu: |4.76%|);
+    # search after the <table> tag so base64 image bytes can't match
+    table = text[text.index("<table"):]
+    assert table.index("hbm") < table.index("mxu")
