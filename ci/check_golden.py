@@ -44,16 +44,32 @@ VOLATILE = {"simulation_rate_kops", "wall_seconds"}
 RTOL = 1e-9
 
 
+def _overlay_tag(overlays: list[dict]) -> str:
+    """Filename tag encoding overlay keys AND values, so matrix rows
+    differing only in values cannot collide."""
+    parts: list[str] = []
+
+    def walk(prefix: str, d: dict) -> None:
+        for k, v in sorted(d.items()):
+            if isinstance(v, dict):
+                walk(f"{prefix}{k}.", v)
+            else:
+                parts.append(f"{prefix}{k}={v}")
+
+    for o in overlays:
+        walk("", o)
+    return "_".join(parts).replace("/", "-").replace(" ", "")
+
+
 def run_matrix() -> dict[str, dict[str, float]]:
     from tpusim.sim.driver import simulate_trace
 
     out: dict[str, dict[str, float]] = {}
     for fixture, arch, overlays in MATRIX:
-        name = f"{fixture}__{arch}" + (
-            "__" + "_".join(
-                sorted(str(k) for o in overlays for k in o)
-            ) if overlays else ""
-        )
+        name = f"{fixture}__{arch}"
+        tag = _overlay_tag(overlays)
+        if tag:
+            name += "__" + tag
         report = simulate_trace(
             FIXTURES / fixture, arch=arch, overlays=list(overlays)
         )

@@ -38,13 +38,15 @@ def correlation_stats(points: list[CorrelationPoint]) -> dict[str, float]:
     cov = sum((x - mx) * (y - my) for x, y in zip(xs, ys))
     vx = sum((x - mx) ** 2 for x in xs)
     vy = sum((y - my) ** 2 for y in ys)
-    corr = cov / math.sqrt(vx * vy) if vx > 0 and vy > 0 else 1.0
-    return {
+    out = {
         "n": n,
         "mean_abs_error_pct": mean_abs,
         "max_abs_error_pct": max_abs,
-        "log_correlation": corr,
     }
+    # undefined for <2 points or zero variance: omit rather than fake 1.0
+    if n >= 2 and vx > 0 and vy > 0:
+        out["log_correlation"] = cov / math.sqrt(vx * vy)
+    return out
 
 
 def _scatter_png(points: list[CorrelationPoint]) -> bytes:
@@ -98,7 +100,8 @@ def write_correlation_report(
     ]
     stats = correlation_stats(points)
     png = _scatter_png(points) if points else b""
-    (out / "correl.png").write_bytes(png)
+    if png:
+        (out / "correl.png").write_bytes(png)
 
     rows = "\n".join(
         "<tr><td>{}</td><td align=right>{:.1f}</td>"
@@ -109,11 +112,15 @@ def write_correlation_report(
         )
         for p in sorted(points, key=lambda p: -p.abs_error_pct)
     )
+    corr = stats.get("log_correlation")
     summary = (
         "<p><b>{n}</b> workloads — mean |error| "
-        "<b>{mean_abs_error_pct:.2f}%</b>, max |error| "
-        "{max_abs_error_pct:.2f}%, log-time correlation "
-        "{log_correlation:.4f}</p>".format(**stats)
+        "<b>{mean:.2f}%</b>, max |error| {mx:.2f}%, "
+        "log-time correlation {corr}</p>".format(
+            n=stats["n"], mean=stats["mean_abs_error_pct"],
+            mx=stats["max_abs_error_pct"],
+            corr=f"{corr:.4f}" if corr is not None else "n/a",
+        )
         if stats.get("n") else "<p>no points</p>"
     )
     if dropped:
@@ -124,6 +131,10 @@ def write_correlation_report(
                 ", ".join(html.escape(p.name) for p in dropped),
             )
         )
+    img_tag = (
+        f'<img src="data:image/png;base64,'
+        f'{base64.b64encode(png).decode()}">' if png else ""
+    )
     doc = f"""<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>{html.escape(title)}</title>
 <style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
@@ -131,7 +142,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <body>
 <h1>{html.escape(title)}</h1>
 {summary}
-<img src="data:image/png;base64,{base64.b64encode(png).decode()}">
+{img_tag}
 <h2>per-workload</h2>
 <table>
 <tr><th>workload</th><th>silicon µs/step</th><th>sim µs/step</th>

@@ -17,5 +17,7 @@ from tpusim.models import microbench as _microbench  # noqa: F401
 from tpusim.models import resnet as _resnet  # noqa: F401
 from tpusim.models import llama as _llama  # noqa: F401
 from tpusim.models import attention as _attention  # noqa: F401
+from tpusim.models import moe as _moe  # noqa: F401
+from tpusim.models import pipeline as _pipeline  # noqa: F401
 
 __all__ = ["Workload", "get_workload", "list_workloads", "register"]

@@ -1,0 +1,26 @@
+"""Small JAX version-compat helpers shared by the model zoo."""
+
+from __future__ import annotations
+
+__all__ = ["varying_over"]
+
+
+def varying_over(value, axis_name: str):
+    """Mark ``value`` as varying over a shard_map mesh axis.
+
+    Fresh constants inside ``shard_map`` are typed unvarying; once a loop
+    carry flows through ``ppermute``/stage math it becomes varying, and the
+    init must match.  The marking API has churned across JAX releases
+    (``lax.pvary`` → ``lax.pcast``), so route through whichever exists;
+    on versions with neither, types unify implicitly and a no-op is right.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        try:
+            return lax.pcast(value, (axis_name,), to="varying")
+        except TypeError:
+            pass
+    if hasattr(lax, "pvary"):
+        return lax.pvary(value, (axis_name,))
+    return value

@@ -56,8 +56,9 @@ def ring_attention(q, k, v, axis_name: str):
     acc = jnp.zeros((b, h, s, d), jnp.float32)
     # fresh constants are unvarying over the mesh axis; the loop carry
     # becomes varying after the first ppermute, so align the types up front
-    if hasattr(lax, "pvary"):
-        m, l, acc = (lax.pvary(x, (axis_name,)) for x in (m, l, acc))
+    from tpusim.models._compat import varying_over
+
+    m, l, acc = (varying_over(x, axis_name) for x in (m, l, acc))
 
     def body(i, carry):
         k_blk, v_blk, m, l, acc = carry

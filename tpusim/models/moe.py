@@ -1,0 +1,153 @@
+"""Mixture-of-Experts layer with expert parallelism (EP).
+
+Fills the expert-parallel slot of the parallelism matrix (SURVEY.md §2.4:
+the rebuild must model the collective patterns training strategies emit).
+An EP MoE lowers to the signature HLO pattern the simulator must time
+well: **two ``all-to-all``s bracketing the expert FFN matmuls** (dispatch
+tokens to their experts' devices, combine results back), plus the gating
+softmax.  The tuner/correlation story for all-to-all rides on this
+workload.
+
+Routing here is deterministic round-robin with learned gate *weighting*
+(not top-k selection): every expert gets an equal token slice, which keeps
+shapes static (no capacity-overflow dropping) and the program fully
+jittable — the standard dense-dispatch TPU formulation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from tpusim.models.registry import register
+
+__all__ = ["moe_ffn"]
+
+
+def moe_ffn(x, wg, w1, w2, axis_name: str):
+    """Expert-parallel MoE FFN inside ``shard_map``.
+
+    x: [n_loc, D] local tokens; wg: [D, E] gate; w1: [E_loc, D, H],
+    w2: [E_loc, H, D] this device's expert slices (E = ep * E_loc).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ep = jax.lax.psum(1, axis_name)
+    e_loc = w1.shape[0]
+    n_experts = ep * e_loc
+    n_loc, d = x.shape
+    cap = n_loc // n_experts
+    assert cap > 0, "need at least one token per expert"
+    used = cap * n_experts
+
+    gates = jax.nn.softmax(
+        (x.astype(jnp.float32) @ wg.astype(jnp.float32)), axis=-1
+    )  # [n_loc, E]
+
+    # round-robin dispatch: token t -> expert t // cap
+    xr = x[:used].reshape(n_experts, cap, d)
+    # all-to-all #1: expert dim scattered across devices, token slices
+    # gathered -> [e_loc, ep*cap, d] on each device
+    xs = jax.lax.all_to_all(
+        xr, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    h = jnp.einsum("ecd,edh->ech", xs, w1)
+    h = jax.nn.relu(h)
+    ys = jnp.einsum("ech,ehd->ecd", h, w2)
+    # all-to-all #2: combine back -> [E, cap, d] of this device's tokens
+    yr = jax.lax.all_to_all(
+        ys, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    # weight each token by its assigned expert's gate probability,
+    # normalized by E so a uniform gate passes signal at unit scale
+    # (keeps the combine well-conditioned for training)
+    gsel = gates[:used].reshape(n_experts, cap, n_experts)
+    w = jnp.take_along_axis(
+        gsel,
+        jnp.arange(n_experts)[:, None, None].repeat(cap, 1),
+        axis=2,
+    )[..., 0] * n_experts  # [E, cap]
+    out = (yr * w[..., None].astype(yr.dtype)).reshape(used, d)
+    if used < n_loc:
+        out = jnp.concatenate([out, x[used:]], axis=0)
+    return out
+
+
+def _build_moe(
+    tokens: int, d_model: int, d_hidden: int, n_experts: int, ep: int,
+    dtype: str, train: bool,
+):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    assert n_experts % ep == 0, "experts must divide evenly across devices"
+    e_loc = n_experts // ep
+    dt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    x = jax.random.normal(k1, (tokens, d_model), dt)
+    wg = jax.random.normal(k2, (d_model, n_experts), jnp.float32) * 0.02
+    w1 = jax.random.normal(
+        k3, (n_experts, d_model, d_hidden), dt) * (d_model ** -0.5)
+    w2 = jax.random.normal(
+        k4, (n_experts, d_hidden, d_model), dt) * (d_hidden ** -0.5)
+    # self-check target: a fixed rotation of the input — learnable, unlike
+    # independent noise (k5 reserved: keep key split stable)
+    del k5
+    y = jnp.roll(x, 1, axis=-1)
+
+    mesh = Mesh(np.array(jax.devices()[:ep]), ("ep",))
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("ep"), P(None), P("ep"), P("ep")),
+        out_specs=P("ep"),
+    )
+    def fwd(x, wg, w1, w2):
+        return moe_ffn(x, wg, w1, w2, "ep")
+
+    if not train:
+        return fwd, (x, wg, w1, w2)
+
+    def loss_fn(params, x, y):
+        wg, w1, w2 = params
+        out = fwd(x, wg, w1, w2)
+        return ((out - y).astype(jnp.float32) ** 2).mean()
+
+    def train_step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        lr = 0.05
+        new = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g.astype(p.dtype), params, grads
+        )
+        return loss, new
+
+    return train_step, ((wg, w1, w2), x, y)
+
+
+@register(
+    "moe_ep4",
+    description="expert-parallel MoE FFN: all-to-all dispatch/combine over "
+    "4 devices (EP capability slot)",
+    suite="models",
+    num_devices=4,
+    tokens=2048, d_model=512, d_hidden=2048, n_experts=8, ep=4,
+    dtype="bfloat16", train=False,
+)
+def build_moe_ep4(**kw):
+    return _build_moe(**kw)
+
+
+@register(
+    "moe_ep8_train",
+    description="EP-8 MoE train step (gating + experts learned; "
+    "all-to-all in fwd and bwd)",
+    suite="models",
+    num_devices=8,
+    tokens=4096, d_model=512, d_hidden=2048, n_experts=16, ep=8,
+    dtype="float32", train=True,
+)
+def build_moe_ep8(**kw):
+    return _build_moe(**kw)
