@@ -67,3 +67,57 @@ def test_driver_power_stats():
 
 def test_presets_exist():
     assert set(POWER_PRESETS) == {"v4", "v5e", "v5p", "v6e"}
+
+
+# -- DVFS + power-over-time (AccelWattch DVFS / mcpat_cycle sampling slots) --
+
+def test_dvfs_scaling_quadratic():
+    from tpusim.power.model import POWER_PRESETS, PowerModel
+
+    base = POWER_PRESETS["v5p"]
+    down = base.scaled(0.8)
+    assert down.mxu_pj_per_flop == pytest.approx(
+        base.mxu_pj_per_flop * 0.64
+    )
+    assert down.static_watts == pytest.approx(base.static_watts * 0.64)
+    # HBM/SerDes rails are not on the core voltage plane
+    assert down.hbm_pj_per_byte == base.hbm_pj_per_byte
+    assert down.ici_pj_per_byte == base.ici_pj_per_byte
+    # PowerModel applies the scale
+    m = PowerModel("v5p", dvfs_scale=0.8)
+    assert m.coeffs.mxu_pj_per_flop == pytest.approx(
+        base.mxu_pj_per_flop * 0.64
+    )
+
+
+def test_dvfs_overlays_compose():
+    from tpusim.power.model import dvfs_overlays
+    from tpusim.timing.config import SimConfig, overlay
+
+    cfg = SimConfig()
+    scaled = overlay(cfg, *dvfs_overlays(cfg.arch.clock_ghz, 0.9))
+    assert scaled.arch.clock_ghz == pytest.approx(cfg.arch.clock_ghz * 0.9)
+    assert scaled.dvfs_scale == pytest.approx(0.9)
+
+
+def test_power_timeline_tracks_utilization():
+    from tpusim.power.model import POWER_PRESETS, power_timeline
+    from tpusim.sim.interval import IntervalSample
+    from tpusim.timing.config import ArchConfig
+
+    arch = ArchConfig()
+    c = POWER_PRESETS["v5p"]
+    samples = [
+        IntervalSample(0, 100, {"mxu": 100.0}),       # MXU pegged
+        IntervalSample(100, 200, {"mxu": 50.0}),      # half busy
+        IntervalSample(200, 300, {}),                 # idle
+    ]
+    tl = power_timeline(samples, arch, c)
+    assert len(tl) == 3
+    static = c.static_watts + c.idle_clock_watts
+    peak_mxu = c.mxu_pj_per_flop * arch.peak_bf16_flops * 1e-12
+    assert tl[0]["watts"] == pytest.approx(static + peak_mxu)
+    assert tl[1]["watts"] == pytest.approx(static + 0.5 * peak_mxu)
+    assert tl[2]["watts"] == pytest.approx(static)
+    # full-power MXU on v5p should land in the hundreds of watts
+    assert 100 < tl[0]["watts"] < 1500

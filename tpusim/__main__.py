@@ -139,14 +139,29 @@ def _cmd_aerial(args: argparse.Namespace) -> int:
               file=sys.stderr)
     sample = args.sample or cfg.stat_sample_cycles
     samples = sample_intervals(res, sample)
-    if args.gz:
-        write_interval_log(
-            samples, args.gz,
-            meta={"module": mod.name, "arch": cfg.arch.name,
-                  "sample_cycles": sample},
+    power = None
+    if args.power:
+        from tpusim.power.model import power_timeline
+
+        power = power_timeline(
+            samples, cfg.arch, cfg.arch.name, dvfs_scale=cfg.dvfs_scale
         )
+    if args.gz:
+        meta = {"module": mod.name, "arch": cfg.arch.name,
+                "sample_cycles": sample}
+        if power is not None:
+            meta["power_watts"] = [round(w["watts"], 2) for w in power]
+        write_interval_log(samples, args.gz, meta=meta)
         print(f"interval log written to {args.gz}")
     print(render_text_lanes(samples), end="")
+    if power:
+        peak = max(w["watts"] for w in power)
+        avg = sum(w["watts"] for w in power) / len(power)
+        blocks = " ▁▂▃▄▅▆▇█"
+        chars = "".join(
+            blocks[min(int(w["watts"] / peak * 8 + 0.5), 8)] for w in power
+        )
+        print(f"  power |{chars[:72]}| avg {avg:.0f} W peak {peak:.0f} W")
     return 0
 
 
@@ -280,6 +295,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="window size in cycles (default: stat_sample_cycles)")
     pa.add_argument("--gz", default=None,
                     help="also write the gzip'd JSONL interval log here")
+    pa.add_argument("--power", action="store_true",
+                    help="add a TPUWattch power-over-time lane")
     pa.set_defaults(fn=_cmd_aerial)
 
     pb = sub.add_parser(

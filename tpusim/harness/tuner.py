@@ -9,6 +9,12 @@ parameters they expose:
 * ``clock_ghz``        from sustained bf16 matmul throughput (MXU peak)
 * ``hbm_efficiency``   from streamed elementwise bandwidth
 * ``vpu_reduce_slowdown`` from large-reduction throughput
+* ``mxu_fill_cycles``  from a chain of MXU-tile-sized matmuls
+* ``op_overhead_cycles`` from a long chain of dependent tiny ops
+* ``vpu_transcendental_per_cycle`` from an exp/tanh stream
+* ``dtype_mult['f32']`` from the f32/bf16 matmul throughput ratio
+* ``host_bandwidth``   from device_put round-trips
+* ``ici.link_bandwidth`` from a psum sweep (multi-chip hosts only)
 
 emitting a reference-style flag-file overlay (``-arch.clock_ghz 1.67``)
 that ``load_config`` composes — exactly how tuner output feeds
@@ -30,6 +36,12 @@ class TunerResult:
     clock_ghz: float | None = None
     hbm_efficiency: float | None = None
     vpu_reduce_slowdown: float | None = None
+    mxu_fill_cycles: float | None = None
+    op_overhead_cycles: float | None = None
+    transcendental_per_cycle: float | None = None
+    f32_dtype_mult: float | None = None
+    host_bandwidth: float | None = None
+    ici_link_bandwidth: float | None = None
     details: dict | None = None
 
     def overlay_lines(self) -> list[str]:
@@ -41,6 +53,25 @@ class TunerResult:
         if self.vpu_reduce_slowdown:
             lines.append(
                 f"-arch.vpu_reduce_slowdown {self.vpu_reduce_slowdown:.4g}"
+            )
+        if self.mxu_fill_cycles:
+            lines.append(
+                f"-arch.mxu_fill_cycles {round(self.mxu_fill_cycles)}"
+            )
+        if self.op_overhead_cycles:
+            lines.append(
+                f"-arch.op_overhead_cycles {round(self.op_overhead_cycles)}"
+            )
+        if self.transcendental_per_cycle:
+            lines.append(
+                "-arch.vpu_transcendental_per_cycle "
+                f"{round(self.transcendental_per_cycle)}"
+            )
+        if self.host_bandwidth:
+            lines.append(f"-arch.host_bandwidth {self.host_bandwidth:.4g}")
+        if self.ici_link_bandwidth:
+            lines.append(
+                f"-arch.ici.link_bandwidth {self.ici_link_bandwidth:.4g}"
             )
         return lines
 
@@ -95,6 +126,96 @@ def _fit_reduce(arch, clock_ghz: float, n_steps: int = 64) -> float:
     return max(vpu_rate / max(elems_per_cycle, 1e-9), 1.0)
 
 
+def _per_step(workload: str, n_steps: int, iters: int = 3, **build_kw):
+    from tpusim.harness.correlate import loopify
+    from tpusim.models import get_workload
+    from tpusim.tracer.capture import measure_wall_time
+
+    fn, args = get_workload(workload).build(**build_kw)
+    looped = loopify(fn, n_steps)
+    t = measure_wall_time(looped, *args, iters=iters)
+    return t["min_s"] / n_steps
+
+
+def _fit_fill(arch, clock_ghz: float) -> float:
+    """Tile-sized matmul chain: per-matmul time at the fitted clock minus
+    the streaming term is the pipeline fill/drain."""
+    depth = 64
+    per_step = _per_step("small_matmul_chain", 8, size=128, depth=depth)
+    per_mm_cycles = per_step / depth * clock_ghz * 1e9
+    # a 128^3 bf16 matmul occupies one pass: m_pad rows + fill
+    stream_cycles = 128.0 / max(arch.mxu_count, 1)
+    return max(per_mm_cycles - stream_cycles, 1.0)
+
+
+def _fit_op_overhead(clock_ghz: float) -> float:
+    """Dependent tiny-op chain: marginal per-op cycles."""
+    shallow, deep = 64, 256
+    t_shallow = _per_step("op_overhead_chain", 8, depth=shallow)
+    t_deep = _per_step("op_overhead_chain", 8, depth=deep)
+    per_op = (t_deep - t_shallow) / (deep - shallow)
+    return max(per_op * clock_ghz * 1e9, 0.0)
+
+
+def _fit_transcendental(clock_ghz: float) -> float:
+    """exp+tanh stream: transcendentals retired per cycle."""
+    elems = 8 * 1024 * 1024
+    per_step = _per_step("transcendental", 16, elems=elems)
+    # tanh(exp(x)) = 2 transcendental ops per element
+    ops = 2.0 * elems
+    return ops / (per_step * clock_ghz * 1e9)
+
+
+def _fit_f32_mult(mxu_achieved_bf16: float) -> float:
+    """f32/bf16 matmul throughput ratio (the dtype_mult table entry)."""
+    n = 4096
+    per_step = _per_step(
+        "matmul", 8, m=n, n=n, k=n, dtype="float32"
+    )
+    achieved_f32 = 2.0 * n ** 3 / per_step
+    return achieved_f32 / max(mxu_achieved_bf16, 1.0)
+
+
+def _fit_host_bw() -> float:
+    """device_put of a large host buffer: host->HBM bandwidth."""
+    import time
+
+    import jax
+    import numpy as np
+
+    nbytes = 256 * 1024 * 1024
+    host = np.ones(nbytes // 4, np.float32)
+    jax.device_put(host[:1024]).block_until_ready()  # warm path
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        buf = jax.device_put(host)
+        buf.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+        del buf
+    return nbytes / best
+
+
+def _fit_ici(arch) -> float | None:
+    """psum over the local mesh -> achieved per-link bandwidth.  Needs
+    more than one device; returns None on single-chip hosts."""
+    import jax
+
+    n = len(jax.devices())
+    if n < 2:
+        return None
+    elems = 8 * 1024 * 1024
+    per_step = _per_step("ici_allreduce", 8, elems=elems)
+    payload = 4.0 * elems               # f32 bytes per device
+    # ring all-reduce moves 2(n-1)/n * payload over D directions
+    from tpusim.ici.topology import torus_for
+
+    topo = torus_for(n, arch.name)
+    directions = max(2 * sum(1 for d in topo.dims if d > 1), 2)
+    moved = 2.0 * (n - 1) / n * payload
+    return moved / per_step / directions
+
+
 def tune(arch_name: str | None = None) -> TunerResult:
     """Run the fit suite on the local device."""
     import jax
@@ -108,12 +229,31 @@ def tune(arch_name: str | None = None) -> TunerResult:
     hbm_eff, hbm_achieved = _fit_hbm(arch)
     reduce_slow = _fit_reduce(arch, clock)
 
+    def _try(fn, *a):
+        try:
+            return fn(*a)
+        except Exception:
+            return None
+
+    fill = _try(_fit_fill, arch, clock)
+    overhead = _try(_fit_op_overhead, clock)
+    transc = _try(_fit_transcendental, clock)
+    f32_mult = _try(_fit_f32_mult, mxu_achieved)
+    host_bw = _try(_fit_host_bw)
+    ici_bw = _try(_fit_ici, arch)
+
     return TunerResult(
         device_kind=dev.device_kind,
         base_arch=arch.name,
         clock_ghz=round(clock, 3),
         hbm_efficiency=round(hbm_eff, 3),
         vpu_reduce_slowdown=round(reduce_slow, 2),
+        mxu_fill_cycles=round(fill, 1) if fill else None,
+        op_overhead_cycles=round(overhead, 1) if overhead else None,
+        transcendental_per_cycle=round(transc, 1) if transc else None,
+        f32_dtype_mult=round(f32_mult, 4) if f32_mult else None,
+        host_bandwidth=round(host_bw, 1) if host_bw else None,
+        ici_link_bandwidth=round(ici_bw, 1) if ici_bw else None,
         details={
             "mxu_achieved_tflops": mxu_achieved / 1e12,
             "hbm_achieved_gbps": hbm_achieved / 1e9,

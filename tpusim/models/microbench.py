@@ -177,3 +177,75 @@ def build_mlp_train(batch: int, width: int, depth: int, dtype: str, lr: float):
     ) * (1.0 / width ** 0.5)
     y = x @ target_map
     return step, (params, x, y)
+
+
+@register(
+    "small_matmul_chain",
+    description="chain of MXU-tile-sized matmuls (fill/drain overhead fit)",
+    suite="ubench",
+    size=128, depth=64, dtype="bfloat16",
+)
+def build_small_matmul_chain(size: int, depth: int, dtype: str):
+    jnp = _jnp()
+    import jax
+
+    def f(x):
+        for _ in range(depth):
+            x = x @ x
+        return x
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (size, size), jnp.dtype(dtype)) * (
+        size ** -0.5
+    )
+    return f, (x,)
+
+
+@register(
+    "op_overhead_chain",
+    description="long chain of dependent tiny ops (per-op dispatch "
+    "overhead fit)",
+    suite="ubench",
+    depth=256,
+)
+def build_op_overhead_chain(depth: int):
+    jnp = _jnp()
+
+    def f(x):
+        for i in range(depth):
+            # alternate ops so XLA can't collapse the chain
+            x = x * 1.0001 if i % 2 == 0 else x + 1e-7
+        return x
+
+    x = jnp.ones((8, 128), jnp.float32)
+    return f, (x,)
+
+
+@register(
+    "ici_allreduce",
+    description="psum over all local devices (ICI bandwidth/latency fit "
+    "on multi-chip hosts)",
+    suite="ubench",
+    num_devices=0,  # uses all available
+    elems=8 * 1024 * 1024, dtype="float32",
+)
+def build_ici_allreduce(elems: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (n * elems,), jnp.dtype(dtype)
+    )
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=P("d"), out_specs=P("d")
+    )
+    def f(x):
+        return jax.lax.psum(x, "d") * (1.0 / n)
+
+    return f, (x,)

@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 
 from tpusim.timing.engine import EngineResult
 
-__all__ = ["PowerCoefficients", "PowerModel", "PowerReport"]
+__all__ = ["PowerCoefficients", "PowerModel", "PowerReport", "power_timeline", "dvfs_overlays", "POWER_PRESETS"]
 
 
 @dataclass(frozen=True)
@@ -39,6 +39,35 @@ class PowerCoefficients:
     ici_pj_per_byte: float = 10.0      # SerDes + link
     static_watts: float = 70.0         # leakage
     idle_clock_watts: float = 35.0     # clock tree / sequencer
+
+    def scaled(self, voltage_scale: float) -> "PowerCoefficients":
+        """DVFS voltage scaling (the AccelWattch DVFS slot): per-event
+        switching energy goes as V², and leakage roughly tracks V² at
+        nearby operating points.  Pair with a ``clock_ghz`` overlay on the
+        timing side — :func:`dvfs_overlays` builds both."""
+        v2 = voltage_scale ** 2
+        return PowerCoefficients(
+            name=self.name,
+            mxu_pj_per_flop=self.mxu_pj_per_flop * v2,
+            vpu_pj_per_flop=self.vpu_pj_per_flop * v2,
+            sfu_pj_per_op=self.sfu_pj_per_op * v2,
+            hbm_pj_per_byte=self.hbm_pj_per_byte,   # HBM rail is separate
+            vmem_pj_per_byte=self.vmem_pj_per_byte * v2,
+            ici_pj_per_byte=self.ici_pj_per_byte,   # SerDes rail too
+            static_watts=self.static_watts * v2,
+            idle_clock_watts=self.idle_clock_watts * v2 * voltage_scale,
+        )
+
+
+def dvfs_overlays(base_clock_ghz: float, freq_scale: float) -> list[dict]:
+    """Config overlays for a DVFS operating point: scale the core clock
+    (timing side) and record the scale for the power side (``dvfs_scale``
+    is read by the driver when building the PowerModel).  Voltage is
+    assumed ∝ frequency near the nominal point."""
+    return [{
+        "arch": {"clock_ghz": base_clock_ghz * freq_scale},
+        "dvfs_scale": freq_scale,
+    }]
 
 
 #: per-generation coefficient presets (fit targets: published TDP class)
@@ -105,9 +134,15 @@ class PowerReport:
 
 
 class PowerModel:
-    def __init__(self, coeffs: PowerCoefficients | str = "v5p"):
+    def __init__(
+        self,
+        coeffs: PowerCoefficients | str = "v5p",
+        dvfs_scale: float = 1.0,
+    ):
         if isinstance(coeffs, str):
             coeffs = POWER_PRESETS.get(coeffs, PowerCoefficients(name=coeffs))
+        if dvfs_scale != 1.0:
+            coeffs = coeffs.scaled(dvfs_scale)
         self.coeffs = coeffs
 
     def report(self, result: EngineResult) -> PowerReport:
@@ -128,3 +163,47 @@ class PowerModel:
             static_watts=c.static_watts,
             idle_watts=c.idle_clock_watts,
         )
+
+
+def power_timeline(samples, arch, coeffs: PowerCoefficients | str = "v5p",
+                   dvfs_scale: float = 1.0):
+    """Per-window power from interval utilization samples — the
+    time-resolved view AccelWattch produces by calling ``mcpat_cycle``
+    every sample period (``gpu-sim.cc:1993-2001``).
+
+    Per-unit dynamic power is the unit's peak event rate × per-event
+    energy × its busy fraction in the window (a roofline-style activity
+    factor; the totals-based :meth:`PowerModel.report` remains the
+    energy-accurate accounting).  Returns one dict per window.
+    """
+    if isinstance(coeffs, str):
+        coeffs = POWER_PRESETS.get(coeffs, PowerCoefficients(name=coeffs))
+    if dvfs_scale != 1.0:
+        coeffs = coeffs.scaled(dvfs_scale)
+    c = coeffs
+    # peak dynamic watts per unit at 100% utilization
+    ici_links = 6  # 3D-torus chip: 2 directions x 3 axes
+    peak = {
+        "mxu": c.mxu_pj_per_flop * arch.peak_bf16_flops * 1e-12,
+        "vpu": c.vpu_pj_per_flop * arch.vpu_flops_per_cycle
+               * arch.clock_hz * 1e-12,
+        "dma": c.hbm_pj_per_byte * arch.hbm_bandwidth * 1e-12,
+        "ici": c.ici_pj_per_byte * arch.ici.link_bandwidth
+               * max(arch.ici.links_per_axis, 1) * ici_links * 1e-12,
+    }
+    out = []
+    for s in samples:
+        comps = {
+            unit: peak.get(unit, 0.0) * s.utilization(unit)
+            for unit in s.unit_busy
+            if peak.get(unit)
+        }
+        total = sum(comps.values()) + c.static_watts + c.idle_clock_watts
+        out.append({
+            "t0": s.t0,
+            "t1": s.t1,
+            "watts": total,
+            "components": comps,
+            "static_watts": c.static_watts + c.idle_clock_watts,
+        })
+    return out

@@ -282,7 +282,9 @@ class SimDriver:
         if cfg.power_enabled:
             from tpusim.power.model import PowerModel
 
-            preport = PowerModel(arch.name).report(report.totals)
+            preport = PowerModel(
+                arch.name, dvfs_scale=cfg.dvfs_scale
+            ).report(report.totals)
             report.stats.update(preport.stats_dict(), prefix="")
             report.power = preport
         return report

@@ -181,6 +181,10 @@ class SimConfig:
     default_loop_trip_count: int = 1
     # power model on/off (reference: -power_simulation_enabled)
     power_enabled: bool = False
+    # DVFS operating point (reference: AccelWattch DVFS support): voltage/
+    # frequency scale applied to the power coefficients; pair with a
+    # clock_ghz overlay — power.model.dvfs_overlays builds both
+    dvfs_scale: float = 1.0
     # checkpoint/resume at kernel granularity (reference:
     # -checkpoint_kernel / -resume_kernel, abstract_hardware_model.cc:136):
     # resume fast-forwards the first N kernel launches; checkpoint stops
