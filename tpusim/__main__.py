@@ -155,28 +155,24 @@ def _cmd_aerial(args: argparse.Namespace) -> int:
         print(f"interval log written to {args.gz}")
     print(render_text_lanes(samples), end="")
     if power:
-        peak = max(w["watts"] for w in power)
-        avg = sum(w["watts"] for w in power) / len(power)
-        blocks = " ▁▂▃▄▅▆▇█"
-        chars = "".join(
-            blocks[min(int(w["watts"] / peak * 8 + 0.5), 8)] for w in power
-        )
-        print(f"  power |{chars[:72]}| avg {avg:.0f} W peak {peak:.0f} W")
+        from tpusim.sim.interval import render_scalar_lane
+
+        watts = [w["watts"] for w in power]
+        avg = sum(watts) / len(watts)
+        print(render_scalar_lane(
+            watts, "power",
+            suffix=f" avg {avg:.0f} W peak {max(watts):.0f} W",
+        ), end="")
     return 0
 
 
 def _cmd_tune(args: argparse.Namespace) -> int:
     from tpusim.harness.tuner import tune, write_overlay
 
+    import dataclasses
+
     result = tune(args.arch)
-    print(json.dumps({
-        "device_kind": result.device_kind,
-        "base_arch": result.base_arch,
-        "clock_ghz": result.clock_ghz,
-        "hbm_efficiency": result.hbm_efficiency,
-        "vpu_reduce_slowdown": result.vpu_reduce_slowdown,
-        "details": result.details,
-    }, indent=2))
+    print(json.dumps(dataclasses.asdict(result), indent=2))
     if args.out:
         write_overlay(result, args.out)
         print(f"overlay written to {args.out}")

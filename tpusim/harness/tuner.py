@@ -67,6 +67,10 @@ class TunerResult:
                 "-arch.vpu_transcendental_per_cycle "
                 f"{round(self.transcendental_per_cycle)}"
             )
+        if self.f32_dtype_mult:
+            lines.append(
+                f"-arch.dtype_mult.f32 {self.f32_dtype_mult:.4g}"
+            )
         if self.host_bandwidth:
             lines.append(f"-arch.host_bandwidth {self.host_bandwidth:.4g}")
         if self.ici_link_bandwidth:
@@ -143,8 +147,11 @@ def _fit_fill(arch, clock_ghz: float) -> float:
     depth = 64
     per_step = _per_step("small_matmul_chain", 8, size=128, depth=depth)
     per_mm_cycles = per_step / depth * clock_ghz * 1e9
-    # a 128^3 bf16 matmul occupies one pass: m_pad rows + fill
-    stream_cycles = 128.0 / max(arch.mxu_count, 1)
+    # the cost model prices a single 128^3 matmul as one serial pass of
+    # m_pad + fill cycles (cost.py mxu_cycles: passes=1 -> serial=1, so
+    # mxu_count does NOT divide it); subtract the m_pad=128 streaming term
+    del arch
+    stream_cycles = 128.0
     return max(per_mm_cycles - stream_cycles, 1.0)
 
 
@@ -229,18 +236,21 @@ def tune(arch_name: str | None = None) -> TunerResult:
     hbm_eff, hbm_achieved = _fit_hbm(arch)
     reduce_slow = _fit_reduce(arch, clock)
 
-    def _try(fn, *a):
+    fit_errors: dict[str, str] = {}
+
+    def _try(label, fn, *a):
         try:
             return fn(*a)
-        except Exception:
+        except Exception as e:  # record, don't abort the whole tune
+            fit_errors[label] = f"{type(e).__name__}: {e}"
             return None
 
-    fill = _try(_fit_fill, arch, clock)
-    overhead = _try(_fit_op_overhead, clock)
-    transc = _try(_fit_transcendental, clock)
-    f32_mult = _try(_fit_f32_mult, mxu_achieved)
-    host_bw = _try(_fit_host_bw)
-    ici_bw = _try(_fit_ici, arch)
+    fill = _try("mxu_fill_cycles", _fit_fill, arch, clock)
+    overhead = _try("op_overhead_cycles", _fit_op_overhead, clock)
+    transc = _try("transcendental_per_cycle", _fit_transcendental, clock)
+    f32_mult = _try("f32_dtype_mult", _fit_f32_mult, mxu_achieved)
+    host_bw = _try("host_bandwidth", _fit_host_bw)
+    ici_bw = _try("ici_link_bandwidth", _fit_ici, arch)
 
     return TunerResult(
         device_kind=dev.device_kind,
@@ -257,6 +267,7 @@ def tune(arch_name: str | None = None) -> TunerResult:
         details={
             "mxu_achieved_tflops": mxu_achieved / 1e12,
             "hbm_achieved_gbps": hbm_achieved / 1e9,
+            **({"fit_errors": fit_errors} if fit_errors else {}),
         },
     )
 

@@ -181,15 +181,20 @@ def power_timeline(samples, arch, coeffs: PowerCoefficients | str = "v5p",
     if dvfs_scale != 1.0:
         coeffs = coeffs.scaled(dvfs_scale)
     c = coeffs
-    # peak dynamic watts per unit at 100% utilization
-    ici_links = 6  # 3D-torus chip: 2 directions x 3 axes
+    # peak dynamic watts per unit at 100% utilization; the DMA rate
+    # mirrors what the engine actually models (efficiency-derated HBM),
+    # and the ICI link count follows the configured topology
+    ici_axes = {"torus3d": 3, "torus2d": 2, "mesh2d": 2, "ring": 1}.get(
+        arch.ici.topology, 3
+    )
     peak = {
         "mxu": c.mxu_pj_per_flop * arch.peak_bf16_flops * 1e-12,
         "vpu": c.vpu_pj_per_flop * arch.vpu_flops_per_cycle
                * arch.clock_hz * 1e-12,
-        "dma": c.hbm_pj_per_byte * arch.hbm_bandwidth * 1e-12,
+        "dma": c.hbm_pj_per_byte * arch.hbm_bandwidth
+               * arch.hbm_efficiency * 1e-12,
         "ici": c.ici_pj_per_byte * arch.ici.link_bandwidth
-               * max(arch.ici.links_per_axis, 1) * ici_links * 1e-12,
+               * max(arch.ici.links_per_axis, 1) * 2 * ici_axes * 1e-12,
     }
     out = []
     for s in samples:

@@ -26,6 +26,7 @@ __all__ = [
     "write_interval_log",
     "read_interval_log",
     "render_text_lanes",
+    "render_scalar_lane",
 ]
 
 
@@ -156,3 +157,24 @@ def render_text_lanes(
                                      len(_BLOCKS) - 1)])
         lines.append(f"{u:>7s} |{''.join(chars)}|")
     return "\n".join(lines) + "\n"
+
+
+def render_scalar_lane(
+    values: list[float], label: str, width: int = 72,
+    suffix: str = "",
+) -> str:
+    """One sparkline lane for an arbitrary per-window scalar series (e.g.
+    watts), using the same glyph ramp and quantization as the unit lanes."""
+    if not values:
+        return f"{label:>7s} |{'':{width}}|{suffix}\n"
+    peak = max(values) or 1.0
+    cols = min(len(values), width)
+    per = len(values) / cols
+    chars = []
+    for c in range(cols):
+        lo, hi = int(c * per), max(int((c + 1) * per), int(c * per) + 1)
+        chunk = values[lo:hi]
+        v = sum(chunk) / len(chunk) / peak
+        chars.append(_BLOCKS[min(int(v * (len(_BLOCKS) - 1) + 0.5),
+                                 len(_BLOCKS) - 1)])
+    return f"{label:>7s} |{''.join(chars)}|{suffix}\n"
