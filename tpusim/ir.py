@@ -228,7 +228,13 @@ class TraceOp:
 
     @property
     def base(self) -> str:
-        return base_opcode(self.opcode)
+        # hot in the schedule walk: memoize per op (opcode never mutates
+        # after parse)
+        b = self.__dict__.get("_base")
+        if b is None:
+            b = base_opcode(self.opcode)
+            self.__dict__["_base"] = b
+        return b
 
     @property
     def is_async_start(self) -> bool:
