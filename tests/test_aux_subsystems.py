@@ -228,3 +228,22 @@ def test_debugger_list_and_help(tiny_mlp):
     text = _run_debugger(tiny_mlp, "l 3\nbogus\nq\n")
     assert "[   0]" in text
     assert "commands:" in text
+
+
+# -- deadlock / runaway detection -------------------------------------------
+
+def test_deadlock_detect_flags_runaway():
+    pod = _pod(1)
+    report = SimDriver(
+        overlay(SimConfig(), {"deadlock_cycles": 1})  # absurdly low budget
+    ).run(pod)
+    assert report.stats.get("deadlock_suspected") == 1
+    assert "m:" in report.stats.get("deadlock_suspects")
+    # and a normal budget does not flag
+    clean = SimDriver(SimConfig()).run(pod)
+    assert clean.stats.get("deadlock_suspected") is None
+    # opting out disables the check entirely
+    off = SimDriver(
+        overlay(SimConfig(), {"deadlock_cycles": 1, "deadlock_detect": False})
+    ).run(pod)
+    assert off.stats.get("deadlock_suspected") is None

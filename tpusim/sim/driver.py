@@ -277,6 +277,20 @@ class SimDriver:
                     "collective_counts_per_device", ";".join(sorted(set(ragged)))
                 )
 
+        # deadlock/runaway detection (the -gpu_deadlock_detect analogue,
+        # gpu-sim.h:443): an analytic replay cannot stall, but a corrupt
+        # trace or unresolved loop bound can send the cycle count to
+        # absurdity — flag it with the biggest offenders
+        if cfg.deadlock_detect and report.cycles > cfg.deadlock_cycles:
+            report.stats.set("deadlock_suspected", 1)
+            worst = sorted(
+                module_results.items(), key=lambda kv: -kv[1].cycles
+            )[:3]
+            report.stats.set(
+                "deadlock_suspects",
+                ";".join(f"{name}:{r.cycles:.3g}cy" for name, r in worst),
+            )
+
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)
         if cfg.power_enabled:
