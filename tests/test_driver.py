@@ -111,3 +111,31 @@ def test_multi_stream_overlap():
     overlapped = SimDriver(cfg).run(build(streams=True))
     serial = SimDriver(cfg).run(build(streams=False))
     assert overlapped.cycles < serial.cycles
+
+
+def test_kernel_window_bounds_lookahead():
+    """With kernel_window=1, a second-stream memcpy issued after N kernels
+    cannot overlap them all — it waits for all but the newest in-flight
+    kernel (main.cc:74-115 busy-stream gating).  A wide window lets it
+    overlap from cycle 0."""
+    from tests.test_aux_subsystems import _pod
+    from tpusim.ir import CommandKind, TraceCommand
+    from tpusim.timing.config import overlay
+
+    def pod():
+        p = _pod(3)  # three kernels on stream 0
+        p.device(0).commands.append(TraceCommand(
+            kind=CommandKind.MEMCPY_H2D, nbytes=64 * 1024 * 1024,
+            stream_id=1,
+        ))
+        return p
+
+    wide = SimDriver(SimConfig()).run(pod())
+    narrow = SimDriver(
+        overlay(SimConfig(), {"kernel_window": 1})
+    ).run(pod())
+    # kernel timing itself is unchanged (they serialize on the core)
+    assert [k.end_cycle for k in narrow.kernels] == \
+        [k.end_cycle for k in wide.kernels]
+    # but the trailing memcpy is pushed behind the second kernel's end
+    assert narrow.cycles > wide.cycles

@@ -149,15 +149,24 @@ class SimDriver:
         resume_k = max(cfg.resume_kernel, 0)
         checkpoint_k = max(cfg.checkpoint_kernel, 0)
 
+        window = max(cfg.kernel_window, 1)
+
         for dev_id in device_ids:
             dev = pod.devices.get(dev_id)
             if dev is None:
                 continue
             coll_index = 0
             kernel_index = 0
+            # completion times of this device's kernel launches, in launch
+            # order — the stream-window gate (main.cc:74-115): no command
+            # may issue while `window` kernels are still in flight, so
+            # far-ahead DMA/collective prefetch is bounded
+            kernel_ends: list[float] = []
             for cmd in dev.commands:
                 key = (dev_id, cmd.stream_id)
                 ready = stream_free[key]
+                if len(kernel_ends) >= window:
+                    ready = max(ready, kernel_ends[-window])
 
                 # kernel-granularity checkpoint/resume boundary: "after
                 # kernel K completes".  The k-th kernel is in the first
@@ -190,6 +199,7 @@ class SimDriver:
                     end = start + dur
                     core_free[dev_id] = end
                     stream_free[key] = end
+                    kernel_ends.append(end)
                     report.kernels.append(KernelRecord(
                         cmd.module, dev_id, cmd.stream_id, start, end, res
                     ))
