@@ -11,7 +11,9 @@ from tpusim.models import get_workload, list_workloads
 def test_registry():
     names = {w.name for w in list_workloads()}
     assert {"matmul", "conv2d", "resnet50", "llama_tiny",
-            "llama7b_tp8dp8", "ring_attention_sp8"} <= names
+            "llama7b_tp8dp8", "ring_attention_sp8", "moe_ep4",
+            "pipeline_pp4", "embedding_lookup", "lstm_layer",
+            "small_matmul_chain", "ici_allreduce"} <= names
     with pytest.raises(KeyError):
         get_workload("nope")
 

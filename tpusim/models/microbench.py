@@ -249,3 +249,60 @@ def build_ici_allreduce(elems: int, dtype: str):
         return jax.lax.psum(x, "d") * (1.0 / n)
 
     return f, (x,)
+
+
+@register(
+    "embedding_lookup",
+    description="large embedding-table gather + reduce (HBM random access)",
+    suite="ubench",
+    vocab=262144, dim=1024, lookups=16384, dtype="bfloat16",
+)
+def build_embedding_lookup(vocab: int, dim: int, lookups: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    table = jax.random.normal(
+        jax.random.PRNGKey(0), (vocab, dim), jnp.dtype(dtype)
+    )
+    ids = jax.random.randint(
+        jax.random.PRNGKey(1), (lookups,), 0, vocab, jnp.int32
+    )
+
+    def f(table, ids):
+        return jnp.take(table, ids, axis=0).sum(axis=0)
+
+    return f, (table, ids)
+
+
+@register(
+    "lstm_layer",
+    description="LSTM layer over a sequence (scan of gate matmuls — the "
+    "DeepBench RNN slot)",
+    suite="ubench",
+    batch=64, hidden=1024, seq=128, dtype="bfloat16",
+)
+def build_lstm_layer(batch: int, hidden: int, seq: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    kx, kw, ku = jax.random.split(key, 3)
+    xs = jax.random.normal(kx, (seq, batch, hidden), dt)
+    w = jax.random.normal(kw, (hidden, 4 * hidden), dt) * (hidden ** -0.5)
+    u = jax.random.normal(ku, (hidden, 4 * hidden), dt) * (hidden ** -0.5)
+    b = jnp.zeros((4 * hidden,), dt)
+
+    def f(xs, w, u, b):
+        def cell(carry, x):
+            h, c = carry
+            z = x @ w + h @ u + b
+            i, f_, g, o = jnp.split(z, 4, axis=-1)
+            c = jax.nn.sigmoid(f_) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+        h0 = jnp.zeros((xs.shape[1], w.shape[0]), xs.dtype)
+        (_, _), hs = jax.lax.scan(cell, (h0, h0), xs)
+        return hs
+
+    return f, (xs, w, u, b)
