@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Headline benchmark: sim-cycle accuracy vs silicon.
 
-Runs a small correlation suite on the local TPU chip — compute-bound,
+Runs a correlation suite on the local TPU chip — compute-bound,
 bandwidth-bound, and mixed workloads — comparing the timing engine's
 estimate of each captured HLO program against fenced wall-clock measurement
 of the same program on the device (the framework's whole point; north-star
@@ -13,30 +13,66 @@ Prints ONE json line:
   unit         "%"
   vs_baseline  value / 15.0  (the reference north-star bound; <1.0 beats it)
 
-Extra per-workload detail goes to stderr so stdout stays one line.
+Robustness contract (round-2 fix; VERDICT.md "What's weak" #2): the parent
+process NEVER imports jax — on this image a down axon tunnel can make
+backend init hang or raise, which round 1 turned into rc=1 with no JSON.
+Instead the suite runs in a subprocess (``--child``) with a hard timeout,
+retried with backoff; if the live chip stays unreachable, bench falls back
+to replaying committed silicon fixtures (``reports/silicon/``) through the
+pure-Python engine — real measured device times, no jax import at all.  In
+every terminal state exactly one JSON line goes to stdout.  The reference
+bar: CI that always reports (``travis.sh:1-24``, ``util/job_launching/
+monitor_func_test.py:66-75``).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
+from pathlib import Path
 
+REPO_ROOT = Path(__file__).resolve().parent
+FIXTURE_DIR = Path(
+    os.environ.get("TPUSIM_BENCH_FIXTURES", REPO_ROOT / "reports" / "silicon")
+)
 
+# (workload name, build overrides, scan steps) — small programs get
+# more steps so tunnel RPC jitter amortizes away
 SUITE = [
-    # (workload name, build overrides, scan steps) — small programs get
-    # more steps so tunnel RPC jitter amortizes away
     ("matmul_chain", {"m": 2048, "k": 2048, "depth": 4}, 16),   # MXU-bound
     ("elementwise_stream", {"elems": 32 * 1024 * 1024}, 16),    # HBM-bound
     ("reduction", {"rows": 4096, "cols": 4096}, 64),            # VPU+HBM
     ("mlp_train_step", {"batch": 256, "width": 1024, "depth": 2}, 64),  # mixed
+    ("attention_1chip",
+     {"batch": 4, "seq": 1024, "heads": 8, "head_dim": 128}, 16),
+    ("conv2d", {"batch": 16, "hw": 56, "cin": 64, "cout": 64}, 16),
+    ("embedding_lookup",
+     {"vocab": 131072, "dim": 1024, "lookups": 8192}, 16),
+    ("transcendental", {"elems": 8 * 1024 * 1024}, 16),
+    ("lstm_layer", {"batch": 64, "hidden": 1024, "seq": 64}, 8),
 ]
+
+ATTEMPTS = int(os.environ.get("TPUSIM_BENCH_ATTEMPTS", "3"))
+BACKOFF_S = (0, 30, 90)
+CHILD_TIMEOUT_S = int(os.environ.get("TPUSIM_BENCH_TIMEOUT", "1500"))
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+# --------------------------------------------------------------------------
+# child: live-silicon correlation (runs in a subprocess, may import jax)
+# --------------------------------------------------------------------------
+
+def child_main() -> int:
     import jax
 
     from tpusim.harness.correlate import correlate_workload
@@ -62,10 +98,10 @@ def main() -> int:
             log(f"bench: {name} FAILED: {type(e).__name__}: {e}")
 
     if not points:
-        print(json.dumps({
+        emit({
             "metric": "sim_cycle_error_pct", "value": None, "unit": "%",
             "vs_baseline": None, "error": "no workloads completed",
-        }))
+        })
         return 1
 
     mean_abs = sum(p.abs_error_pct for p in points) / len(points)
@@ -74,6 +110,7 @@ def main() -> int:
         "value": round(mean_abs, 3),
         "unit": "%",
         "vs_baseline": round(mean_abs / 15.0, 4),
+        "source": "live",
         "detail": {
             p.name: {
                 "sim_us": round(p.sim_seconds * 1e6, 1),
@@ -86,8 +123,6 @@ def main() -> int:
         "workloads": len(points),
     }
 
-    import os
-
     report_dir = os.environ.get("TPUSIM_BENCH_REPORT")
     if report_dir:
         try:
@@ -98,9 +133,159 @@ def main() -> int:
         except Exception as e:  # cosmetic step must not eat the result
             log(f"bench: report FAILED: {type(e).__name__}: {e}")
 
-    print(json.dumps(out))
+    emit(out)
     return 0
 
 
+# --------------------------------------------------------------------------
+# fallback: committed silicon fixtures (pure sim — NO jax import)
+# --------------------------------------------------------------------------
+
+def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
+    """Replay committed TPU traces against their committed measured times.
+
+    Returns an exit code, or None when no fixture set is available."""
+    manifest_path = fixture_dir / "manifest.json"
+    if not manifest_path.exists():
+        return None
+
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace
+
+    manifest = json.loads(manifest_path.read_text())
+    arch = manifest.get("arch", "v5e")
+    engine = Engine(load_config(arch=arch))
+
+    detail = {}
+    errs = []
+    for entry in manifest.get("workloads", []):
+        name = entry["name"]
+        try:
+            td = load_trace(fixture_dir / entry["trace"])
+            want = entry.get("module")
+            if want is not None:
+                mod = td.modules[want]
+            elif len(td.modules) == 1:
+                mod = next(iter(td.modules.values()))
+            else:
+                raise ValueError(
+                    f"trace has {len(td.modules)} modules "
+                    f"({sorted(td.modules)}); manifest entry must name one "
+                    f"via 'module'"
+                )
+            res = engine.run(mod)
+            n_steps = float(entry.get("n_steps", 1))
+            sim_s = res.seconds / n_steps
+            real_s = float(entry["real_seconds"])
+            err = 100.0 * (sim_s - real_s) / real_s
+            errs.append(abs(err))
+            detail[name] = {
+                "sim_us": round(sim_s * 1e6, 1),
+                "real_us": round(real_s * 1e6, 1),
+                "err_pct": round(err, 2),
+            }
+            log(f"bench(fixture): {name:24s} sim={sim_s * 1e6:9.1f}us "
+                f"real={real_s * 1e6:9.1f}us err={err:+7.2f}%")
+        except Exception as e:
+            log(f"bench(fixture): {name} FAILED: {type(e).__name__}: {e}")
+
+    if not errs:
+        return None
+    mean_abs = sum(errs) / len(errs)
+    emit({
+        "metric": "sim_cycle_error_pct",
+        "value": round(mean_abs, 3),
+        "unit": "%",
+        "vs_baseline": round(mean_abs / 15.0, 4),
+        "source": "silicon_fixture",
+        "fixture_device": manifest.get("device_kind", "unknown"),
+        "fixture_captured": manifest.get("captured", "unknown"),
+        "detail": detail,
+        "workloads": len(errs),
+    })
+    return 0
+
+
+# --------------------------------------------------------------------------
+# parent: orchestrate with retry/backoff; never imports jax
+# --------------------------------------------------------------------------
+
+def _backend_probe(timeout_s: int = 90) -> bool:
+    """Cheap check that the live backend is reachable (bounded; a down
+    axon tunnel makes ``import jax`` hang, which round 1 turned into a
+    full-timeout rc=124)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s, cwd=REPO_ROOT,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    last_tail = ""
+    for attempt in range(ATTEMPTS):
+        wait = BACKOFF_S[min(attempt, len(BACKOFF_S) - 1)]
+        if wait:
+            log(f"bench: retrying in {wait}s (attempt {attempt + 1}/{ATTEMPTS})")
+            time.sleep(wait)
+        if not _backend_probe():
+            last_tail = "backend probe failed (tunnel down?)"
+            log(f"bench: attempt {attempt + 1}: {last_tail}")
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(Path(__file__).resolve()), "--child"],
+                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                cwd=REPO_ROOT,
+            )
+        except subprocess.TimeoutExpired:
+            last_tail = f"child timed out after {CHILD_TIMEOUT_S}s"
+            log(f"bench: {last_tail}")
+            continue
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-8000:])
+            sys.stderr.flush()
+        line = _last_json_line(proc.stdout)
+        if proc.returncode == 0 and line is not None:
+            print(line, flush=True)
+            return 0
+        last_tail = (proc.stderr or proc.stdout or "")[-2000:]
+        log(f"bench: child attempt {attempt + 1} failed (rc={proc.returncode})")
+
+    log("bench: live chip unreachable; trying committed silicon fixtures")
+    try:
+        rc = fixture_main()
+        if rc is not None:
+            return rc
+    except Exception as e:
+        log(f"bench: fixture fallback FAILED: {type(e).__name__}: {e}")
+
+    emit({
+        "metric": "sim_cycle_error_pct", "value": None, "unit": "%",
+        "vs_baseline": None,
+        "error": f"live TPU unreachable after {ATTEMPTS} attempts and no "
+                 f"silicon fixture present; last: {last_tail[-300:]}",
+    })
+    return 1
+
+
+def _last_json_line(stdout: str) -> str | None:
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line
+            except ValueError:
+                continue
+    return None
+
+
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child_main())
     sys.exit(main())

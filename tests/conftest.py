@@ -31,18 +31,7 @@ def fixtures_dir() -> Path:
     return FIXTURES
 
 
-def cpu_mesh_env(n_devices: int = 8) -> dict[str, str]:
-    """Environment for a subprocess that needs an ``n_devices`` CPU mesh."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(REPO_ROOT)  # drop axon site, keep tpusim
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={n_devices} "
-        + env.get("TPUSIM_EXTRA_XLA_FLAGS", "")
-    ).strip()
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.pop("JAX_PLATFORM_NAME", None)
-    return env
+from tpusim.envutil import cpu_mesh_env  # noqa: E402  (shared recipe)
 
 
 def run_in_cpu_mesh(code: str, n_devices: int = 8, timeout: int = 600) -> str:

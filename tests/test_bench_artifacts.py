@@ -1,0 +1,117 @@
+"""Tests for the two driver artifacts (round-2 hardening; VERDICT.md #1):
+
+- ``bench.py`` must print exactly one JSON line on stdout in EVERY terminal
+  state — live suite, fixture fallback, or unreachable-backend error.
+- ``__graft_entry__.dryrun_multichip`` must succeed from the default
+  (axon-poisoned) environment by re-execing into a clean CPU-mesh
+  subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def _run(cmd, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO_ROOT,
+    )
+
+
+def test_bench_emits_json_when_backend_unreachable(tmp_path):
+    """With zero live attempts and no fixture, bench must still print one
+    parseable JSON line (the round-1 failure mode was rc=1 with none)."""
+    proc = _run(
+        [sys.executable, "bench.py"],
+        env_extra={
+            "TPUSIM_BENCH_ATTEMPTS": "0",
+            # decouple from whatever reports/silicon/ the repo ships
+            "TPUSIM_BENCH_FIXTURES": str(tmp_path / "empty"),
+        },
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"want exactly one stdout line, got: {lines!r}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "sim_cycle_error_pct"
+    assert "error" in out or out["value"] is not None
+    assert proc.returncode == 1  # unreachable + no fixture is a failure
+
+
+def test_bench_fixture_fallback_produces_numeric_value(tmp_path, capsys):
+    """A committed silicon fixture must yield a numeric headline value via
+    the pure-Python engine (no jax import)."""
+    import bench
+
+    fx = tmp_path / "silicon"
+    shutil.copytree(FIXTURES / "traces" / "matmul_512", fx / "matmul_512")
+    manifest = {
+        "arch": "v5e",
+        "device_kind": "TPU v5 lite",
+        "captured": "test",
+        "workloads": [
+            {
+                "name": "matmul_512",
+                "trace": "matmul_512",
+                "n_steps": 1,
+                "real_seconds": 10e-6,
+            }
+        ],
+    }
+    (fx / "manifest.json").write_text(json.dumps(manifest))
+
+    rc = bench.fixture_main(fixture_dir=fx)
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "sim_cycle_error_pct"
+    assert isinstance(out["value"], (int, float))
+    assert out["source"] == "silicon_fixture"
+    assert out["workloads"] == 1
+
+
+def test_bench_last_json_line_parser():
+    import bench
+
+    stdout = "noise\n{broken\n" + json.dumps({"a": 1}) + "\ntrailing"
+    assert bench._last_json_line(stdout) == json.dumps({"a": 1})
+    assert bench._last_json_line("no json here") is None
+    assert bench._last_json_line("") is None
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_from_poisoned_env():
+    """Running __graft_entry__ from the *inherited* environment (axon site
+    active) must still complete: the parent re-execs into a clean CPU mesh."""
+    proc = _run([sys.executable, "__graft_entry__.py", "4"], timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "llama tiny train step" in proc.stdout
+    assert "ring attention" in proc.stdout
+
+
+@pytest.mark.slow
+def test_bench_child_runs_on_cpu_mesh(cpu_mesh_runner):
+    """The child suite must run end-to-end on a CPU backend (numbers are
+    meaningless vs the TPU model, but the mechanics — build, capture,
+    simulate, time, emit JSON — must hold)."""
+    code = (
+        "import subprocess, sys, json\n"
+        "import bench\n"
+        "bench.SUITE = [('matmul_chain', {'m': 256, 'k': 256, 'depth': 2}, 2)]\n"
+        "rc = bench.child_main()\n"
+        "assert rc == 0\n"
+        "print('CHILD_OK')\n"
+    )
+    out = cpu_mesh_runner(code, n_devices=1)
+    assert "CHILD_OK" in out

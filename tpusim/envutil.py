@@ -1,0 +1,37 @@
+"""Environment hygiene helpers for this image's axon-tunneled backend.
+
+The axon sitecustomize registers a tunneled TPU backend at interpreter
+startup; env vars set in-process cannot switch platforms, and when the
+tunnel is down ``import jax`` blocks forever.  Every caller that needs a
+virtual CPU mesh therefore spawns a subprocess with THIS environment —
+one recipe, shared by ``tests/conftest.py``, ``__graft_entry__.py`` and
+the harness, so a change to the workaround lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+__all__ = ["REPO_ROOT", "cpu_mesh_env"]
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def cpu_mesh_env(
+    n_devices: int = 8,
+    *,
+    extra: dict[str, str] | None = None,
+) -> dict[str, str]:
+    """Environment for a subprocess that needs an ``n_devices`` CPU mesh."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT)  # drop the axon site, keep tpusim
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices} "
+        + env.get("TPUSIM_EXTRA_XLA_FLAGS", "")
+    ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.update(extra or {})
+    return env

@@ -130,6 +130,35 @@ def _build_sp(kind: str, batch: int, seq: int, heads: int, head_dim: int,
 
 
 @register(
+    "attention_1chip",
+    description="single-chip multi-head self-attention (softmax(QK^T)V — "
+    "the MXU+VPU mixed workload for silicon correlation)",
+    suite="ubench",
+    batch=4, seq=1024, heads=8, head_dim=128, dtype="bfloat16",
+)
+def build_attention_1chip(batch: int, seq: int, heads: int, head_dim: int,
+                          dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (batch, seq, heads, head_dim)
+    q = jax.random.normal(kq, shape, dt)
+    k = jax.random.normal(kk, shape, dt)
+    v = jax.random.normal(kv, shape, dt)
+
+    def f(q, k, v):
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
+
+    return f, (q, k, v)
+
+
+@register(
     "ring_attention_sp8",
     description="ring attention over an 8-way sequence-parallel ring "
     "(ppermute chain — long-context capability)",
