@@ -247,3 +247,34 @@ def test_deadlock_detect_flags_runaway():
         overlay(SimConfig(), {"deadlock_cycles": 1, "deadlock_detect": False})
     ).run(pod)
     assert off.stats.get("deadlock_suspected") is None
+
+
+BIG_DOT_HLO = """\
+HloModule big, is_scheduled=true
+
+ENTRY %main (a: bf16[2048,2048], b: bf16[2048,2048]) -> bf16[2048,2048] {
+  %a = bf16[2048,2048]{1,0} parameter(0)
+  %b = bf16[2048,2048]{1,0} parameter(1)
+  ROOT %dot.0 = bf16[2048,2048]{1,0} dot(%a, %b), \
+lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_deadlock_suspects_weighted_by_launch_count():
+    """A cheap module launched many times can dominate the pod clock; the
+    suspect ranking must weight per-run cycles by launch count, not point
+    at a single-run-expensive module."""
+    pod = _pod(100)  # "m" (tiny_mlp) launched 100x
+    pod.modules["big"] = parse_hlo_module(BIG_DOT_HLO)
+    pod.device(0).commands.append(
+        TraceCommand(kind=CommandKind.KERNEL_LAUNCH, module="big")
+    )
+    report = SimDriver(
+        overlay(SimConfig(), {"deadlock_cycles": 1})
+    ).run(pod)
+    suspects = report.stats.get("deadlock_suspects")
+    # sanity: "big" is the costlier single run, but "m" dominates in total
+    per_run = {k.module: k.result.cycles for k in report.kernels}
+    assert per_run["big"] > per_run["m"]
+    assert suspects.startswith("m:x100:"), suspects

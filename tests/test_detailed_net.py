@@ -175,6 +175,26 @@ def test_detailed_disjoint_groups_run_concurrently():
     assert both == pytest.approx(one, rel=0.05)
 
 
+def test_detailed_multislice_aliased_group_falls_back_to_analytic():
+    """Replica ids >= num_chips alias onto the same chip under the mod
+    mapping (multi-slice groups); the packet sim would drop the collapsed
+    src==dst transfers, so those groups must be priced analytically."""
+    topo = ring4()
+    cfg = _cfg(chips_per_slice=4)
+    det = DetailedCollectiveModel(topo, cfg)
+    ana = CollectiveModel(topo, cfg)
+    # 8 replicas over a 4-chip slice topology: ids 4..7 alias 0..3
+    info = CollectiveInfo(
+        "all-reduce", replica_groups=(tuple(range(8)),)
+    )
+    payload = 16 * 1024 * 1024.0
+    assert det._aliases_chips(info)
+    assert det.seconds(info, payload) == ana.seconds(info, payload)
+    # non-aliased groups keep the packet-sim path (differs from analytic)
+    clean = CollectiveInfo("all-reduce", replica_groups=((0, 1, 2, 3),))
+    assert not det._aliases_chips(clean)
+
+
 def test_detailed_alltoall_bounded_by_link_load():
     """All-to-all must respect the aggregate link-load lower bound
     (total byte-hops / total directed link capacity) yet beat a

@@ -42,6 +42,49 @@ def test_collective_rendezvous_aligns_not_serializes():
     assert r8.cycles < 2.5 * r2.cycles
 
 
+def test_disjoint_group_rendezvous_not_coupled():
+    """Disjoint replica groups must not synchronize with each other, even
+    when they issue different collective counts (rendezvous is keyed by
+    (group, per-group index), not a global per-device index)."""
+    nb = 64 * 1024 * 1024
+    g01 = CollectiveInfo("all-reduce", replica_groups=((0, 1),))
+    g23 = CollectiveInfo("all-reduce", replica_groups=((2, 3),))
+    mod = parse_hlo_module((FIXTURES / "tiny_mlp.hlo").read_text())
+
+    def group01_cmds(pod):
+        for d in (0, 1):
+            pod.device(d).commands.append(TraceCommand(
+                kind=CommandKind.COLLECTIVE, device_id=d, nbytes=nb,
+                collective=g01,
+            ))
+
+    pod = PodTrace(meta={"num_devices": 4})
+    pod.modules["m"] = mod
+    group01_cmds(pod)
+    for d in (2, 3):
+        # group (2,3) is delayed behind a kernel and issues TWO collectives
+        pod.device(d).commands.append(TraceCommand(
+            kind=CommandKind.KERNEL_LAUNCH, device_id=d, module="m",
+        ))
+        for _ in range(2):
+            pod.device(d).commands.append(TraceCommand(
+                kind=CommandKind.COLLECTIVE, device_id=d, nbytes=nb,
+                collective=g23,
+            ))
+    r = SimDriver(SimConfig()).run(pod)
+
+    # baseline: group (0,1) alone on the same topology
+    solo = PodTrace(meta={"num_devices": 4})
+    group01_cmds(solo)
+    r_solo = SimDriver(SimConfig()).run(solo)
+
+    # (0,1) must finish exactly as if (2,3) didn't exist
+    assert r.device_cycles[0] == pytest.approx(r_solo.device_cycles[0])
+    assert r.device_cycles[0] < r.device_cycles[2]
+    # disjoint groups with different counts are NOT a rendezvous mismatch
+    assert r.stats.get("collective_rendezvous_mismatch") is None
+
+
 def test_report_totals_have_wall_clock_stats():
     mod_text = (FIXTURES / "tiny_mlp.hlo").read_text()
     pod = PodTrace()

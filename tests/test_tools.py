@@ -150,3 +150,37 @@ def test_snapshot_buffers(tmp_path):
         n_devices=1,
     )
     assert "SNAP_OK" in out
+
+
+# a step function whose output[1] structurally matches its input[0]: the
+# carried state must be threaded forward so per-launch snapshots EVOLVE
+# (the reference silicon_checkpoint_tool snapshots evolving device state;
+# identical "per-launch" checkpoints would blind a divergence hunt)
+SNAPSHOT_CARRY_SCRIPT = r"""
+import numpy as np
+import jax.numpy as jnp
+from tpusim.tracer.capture import snapshot_buffers
+
+def step(w, x):
+    loss = ((w - x) ** 2).sum()
+    return loss, w - 0.1 * (w - x)   # (loss, updated_w)
+
+w0 = jnp.ones((4, 4)) * 5.0
+x = jnp.zeros((4, 4))
+paths = snapshot_buffers(step, w0, x, out_dir=OUT, launches=3)
+# 2 buffers per launch x 3 launches
+assert len(paths) == 6, paths
+losses = [float(np.load(p)) for p in paths if "buf0" in p.name]
+# the loss must strictly decrease across launches: state was carried
+assert losses[0] > losses[1] > losses[2], losses
+print("CARRY_OK")
+"""
+
+
+@pytest.mark.slow
+def test_snapshot_buffers_carries_state(tmp_path):
+    out = run_in_cpu_mesh(
+        SNAPSHOT_CARRY_SCRIPT.replace("OUT", repr(str(tmp_path / "ckpt"))),
+        n_devices=1,
+    )
+    assert "CARRY_OK" in out

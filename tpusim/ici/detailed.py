@@ -512,9 +512,24 @@ class DetailedCollectiveModel:
             [self._group_phases(g, kind, payload) for g in groups]
         )
 
+    def _aliases_chips(self, info: CollectiveInfo) -> bool:
+        nc = self.topo.num_chips
+        for g in info.replica_groups:
+            if len({m % nc for m in g}) < len(set(g)):
+                return True
+        return False
+
     # -- dispatch ----------------------------------------------------------
 
     def seconds(self, info: CollectiveInfo, payload_bytes: float) -> float:
+        if self._aliases_chips(info):
+            # multi-slice groups (replica ids >= num_chips) fold distinct
+            # replicas onto one chip under the mod mapping, producing
+            # src==dst transfers the packet sim silently drops — the
+            # collapsed group would understate intra-slice traffic.  Price
+            # those with the analytic model, whose slice/DCN split handles
+            # them explicitly.
+            return self._analytic.seconds(info, payload_bytes)
         phases = self._phases_for(info, float(payload_bytes))
         if not phases:
             return self.cfg.launch_latency

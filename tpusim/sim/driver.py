@@ -133,11 +133,21 @@ class SimDriver:
                 module_results[name] = engine.run(pod.modules[name])
             return module_results[name]
 
-        # Cross-device collective rendezvous: k-th standalone collective on
-        # each participating device must align (NCCL call-order matching).
-        coll_ready: dict[int, list[float]] = defaultdict(list)
+        # Cross-device collective rendezvous: the k-th standalone collective
+        # *over a given replica group* must align across that group's
+        # members (NCCL call-order matching).  Keyed by (group, index) so
+        # disjoint groups never synchronize with each other — a global
+        # per-device index would couple unrelated groups' timing exactly in
+        # the traces where their collective counts diverge.
+        coll_ready: dict[tuple, list[float]] = defaultdict(list)
 
         device_ids = sorted(pod.devices) or [0]
+
+        def _group_of(cmd: TraceCommand, d: int) -> tuple:
+            groups = cmd.collective.replica_groups or []
+            mine = next((tuple(g) for g in groups if d in g), None)
+            # no groups recorded: all devices participate
+            return mine if mine is not None else tuple(device_ids)
         # per-device resource timelines
         core_free = {d: 0.0 for d in device_ids}
         dma_free = {d: 0.0 for d in device_ids}
@@ -155,7 +165,7 @@ class SimDriver:
             dev = pod.devices.get(dev_id)
             if dev is None:
                 continue
-            coll_index = 0
+            coll_counts: Counter = Counter()  # per-group issue index
             kernel_index = 0
             # completion times of this device's kernel launches, in launch
             # order — the stream-window gate (main.cc:74-115): no command
@@ -182,8 +192,9 @@ class SimDriver:
                     else kernel_index < resume_k
                 )
                 if resume_k and in_first_half:
-                    if cmd.kind == CommandKind.COLLECTIVE:
-                        coll_index += 1  # keep rendezvous indices aligned
+                    if cmd.kind == CommandKind.COLLECTIVE and cmd.collective:
+                        # keep rendezvous indices aligned
+                        coll_counts[_group_of(cmd, dev_id)] += 1
                     continue  # fast-forward already-simulated work
                 if checkpoint_k and (
                     kernel_index > checkpoint_k if is_kernel
@@ -221,13 +232,15 @@ class SimDriver:
                     secs = coll.seconds(cmd.collective, float(cmd.nbytes))
                     dur = arch.seconds_to_cycles(secs)
                     start = max(ready, ici_free[dev_id])
-                    # rendezvous with peers' k-th collective: all
+                    # rendezvous with the group's k-th collective: all
                     # participants start together at the latest arrival
-                    peers = coll_ready[coll_index]
+                    grp = _group_of(cmd, dev_id)
+                    k = coll_counts[grp]
+                    coll_counts[grp] += 1
+                    peers = coll_ready[(grp, k)]
                     if peers:
                         start = max(start, max(peers))
-                    coll_ready[coll_index].append(start)
-                    coll_index += 1
+                    coll_ready[(grp, k)].append(start)
                     end = start + dur
                     ici_free[dev_id] = end
                     stream_free[key] = end
@@ -293,12 +306,21 @@ class SimDriver:
         # absurdity — flag it with the biggest offenders
         if cfg.deadlock_detect and report.cycles > cfg.deadlock_cycles:
             report.stats.set("deadlock_suspected", 1)
+            # rank by total contribution (per-run cycles x launch count) —
+            # a cheap module launched 10k times can dominate the pod clock
+            # while a single-run-expensive module is innocent
+            launches = Counter(k.module for k in report.kernels)
             worst = sorted(
-                module_results.items(), key=lambda kv: -kv[1].cycles
+                module_results.items(),
+                key=lambda kv: -(kv[1].cycles * max(launches.get(kv[0], 0), 1)),
             )[:3]
             report.stats.set(
                 "deadlock_suspects",
-                ";".join(f"{name}:{r.cycles:.3g}cy" for name, r in worst),
+                ";".join(
+                    f"{name}:x{max(launches.get(name, 0), 1)}:"
+                    f"{r.cycles * max(launches.get(name, 0), 1):.3g}cy"
+                    for name, r in worst
+                ),
             )
 
         report.wall_seconds = time.perf_counter() - t_start

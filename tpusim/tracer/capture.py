@@ -230,25 +230,78 @@ def snapshot_buffers(
     out_root = Path(out_dir)
     out_root.mkdir(parents=True, exist_ok=True)
     paths: list[Path] = []
-    # a jitted program is pure, so every launch with the same inputs
-    # produces identical buffers: execute once, replicate per launch
-    out = jitted(*args, **kwargs)
-    leaves = [l for l in jax.tree_util.tree_leaves(out)
-              if hasattr(l, "dtype")]
-    for j, leaf in enumerate(leaves):
-        path = out_root / f"launch0_buf{j}.npy"
-        np.save(path, np.asarray(jax.device_get(leaf)))
-        paths.append(path)
+
+    def _sig(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not all(hasattr(l, "shape") and hasattr(l, "dtype")
+                   for l in leaves):
+            return None
+        return treedef, tuple(
+            (tuple(l.shape), str(l.dtype)) for l in leaves
+        )
+
+    def _thread(out, cur_args):
+        """Feed output subtrees back into structurally matching arg slots
+        (e.g. a train step's updated params) so launch i+1 sees launch i's
+        carried state — the reference tool snapshots *evolving* state
+        after each kernel, and that evolution is exactly what a
+        divergence hunt diffs."""
+        candidates = [out]
+        if isinstance(out, (tuple, list)):
+            candidates.extend(out)
+        new_args = list(cur_args)
+        used: set[int] = set()
+        changed = False
+        for pos, a in enumerate(new_args):
+            sa = _sig(a)
+            if sa is None:
+                continue
+            for ci, cand in enumerate(candidates):
+                if ci not in used and _sig(cand) == sa:
+                    new_args[pos] = cand
+                    used.add(ci)
+                    changed = True
+                    break
+        return tuple(new_args), changed
+
+    def _save(i: int, out) -> list:
+        leaves = [l for l in jax.tree_util.tree_leaves(out)
+                  if hasattr(l, "dtype")]
+        for j, leaf in enumerate(leaves):
+            path = out_root / f"launch{i}_buf{j}.npy"
+            np.save(path, np.asarray(jax.device_get(leaf)))
+            paths.append(path)
+        return leaves
+
+    cur_args = args
+    out = jitted(*cur_args, **kwargs)
+    n_bufs = len(_save(0, out))
     for i in range(1, launches):
-        for j in range(len(leaves)):
-            src = out_root / f"launch0_buf{j}.npy"
-            dst = out_root / f"launch{i}_buf{j}.npy"
-            dst.unlink(missing_ok=True)
-            try:
-                os.link(src, dst)
-            except OSError:
-                shutil.copyfile(src, dst)
-            paths.append(dst)
+        cur_args, changed = _thread(out, cur_args)
+        if not changed:
+            # stateless program: launches are identical by jit purity —
+            # replicate launch-0 buffers instead of re-executing, and say so
+            import warnings
+
+            warnings.warn(
+                "snapshot_buffers: no output subtree matches any input; "
+                "treating the program as stateless per launch and "
+                "replicating launch-0 buffers for launches 1.."
+                f"{launches - 1}", stacklevel=2,
+            )
+            for k in range(i, launches):
+                for j in range(n_bufs):
+                    src = out_root / f"launch0_buf{j}.npy"
+                    dst = out_root / f"launch{k}_buf{j}.npy"
+                    dst.unlink(missing_ok=True)
+                    try:
+                        os.link(src, dst)
+                    except OSError:
+                        shutil.copyfile(src, dst)
+                    paths.append(dst)
+            break
+        out = jitted(*cur_args, **kwargs)
+        _save(i, out)
     return paths
 
 
