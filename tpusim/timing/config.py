@@ -191,6 +191,15 @@ class SimConfig:
     # the replay after N launches and records the stop point
     resume_kernel: int = 0
     checkpoint_kernel: int = 0
+    # model HBM bandwidth sharing between async DMA and compute (the
+    # FR-FCFS/queueing slot of the reference, dram_sched.h:41 — here a
+    # fair-share split when both stream concurrently)
+    model_hbm_contention: bool = True
+    # enforce the vmem capacity budget: when a module pins more S(1) bytes
+    # than arch.vmem_bytes, the overflow fraction of vmem traffic is
+    # re-priced at HBM bandwidth (spill) — the shmem/L1 capacity analogue
+    # (gpu-cache.h adaptive_cache_config)
+    model_vmem_capacity: bool = True
 
 
 # ---------------------------------------------------------------------------
