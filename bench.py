@@ -132,6 +132,28 @@ def child_main() -> int:
             log(f"bench: correlation report written to {path}")
         except Exception as e:  # cosmetic step must not eat the result
             log(f"bench: report FAILED: {type(e).__name__}: {e}")
+        try:
+            from tpusim.harness.correl_ops import (
+                correlate_workload_ops, write_correl_ops,
+            )
+
+            op_corrs = []
+            for name, overrides, _steps in SUITE:
+                try:
+                    fn, args = get_workload(name).build(**overrides)
+                    op_corrs.append(correlate_workload_ops(
+                        fn, args, name=name,
+                    ))
+                except Exception as e:
+                    log(f"bench: correl_ops {name} FAILED: "
+                        f"{type(e).__name__}: {e}")
+            if op_corrs:
+                p = write_correl_ops(
+                    op_corrs, Path(report_dir) / "correl_ops.json"
+                )
+                log(f"bench: per-op correlation written to {p}")
+        except Exception as e:
+            log(f"bench: correl_ops FAILED: {type(e).__name__}: {e}")
 
     emit(out)
     return 0

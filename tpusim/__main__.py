@@ -62,6 +62,32 @@ def _cmd_capture(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_correlate_ops(args: argparse.Namespace) -> int:
+    """Per-op silicon correlation for one workload on the live backend
+    (plot-correlation.py at HLO-instruction grain)."""
+    from tpusim.harness.correl_ops import (
+        correlate_workload_ops, write_correl_ops,
+    )
+    from tpusim.models import get_workload
+
+    wl = get_workload(args.workload)
+    fn, wl_args = wl.build()
+    corr = correlate_workload_ops(
+        fn, wl_args, name=wl.name, arch=args.arch, iters=args.iters,
+    )
+    print(f"matched {len(corr.rows)} ops "
+          f"({corr.matched_time_fraction:.0%} of device time); "
+          f"time-weighted |error| = {corr.weighted_abs_error_pct:.1f}%")
+    for r in corr.worst(args.top):
+        print(f"  {r.name:40s} {r.opcode:16s} "
+              f"sim={r.sim_ns:10.0f}ns real={r.real_ns:10.0f}ns "
+              f"err={r.error_pct:+7.1f}%")
+    if args.json:
+        p = write_correl_ops([corr], args.json)
+        print(f"report written to {p}")
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     from tpusim.trace.format import load_trace
 
@@ -247,6 +273,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="also dump every output buffer per launch to "
                          "<out>/checkpoint_files/ (silicon checkpoints)")
     pc.set_defaults(fn=_cmd_capture)
+
+    pco = sub.add_parser(
+        "correlate-ops",
+        help="per-op sim-vs-silicon correlation for a workload (live)",
+    )
+    pco.add_argument("workload")
+    pco.add_argument("--arch", default=None)
+    pco.add_argument("--iters", type=int, default=3)
+    pco.add_argument("--top", type=int, default=10)
+    pco.add_argument("--json", default=None, help="write correl_ops.json")
+    pco.set_defaults(fn=_cmd_correlate_ops)
 
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")

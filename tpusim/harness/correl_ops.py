@@ -1,0 +1,340 @@
+"""Per-op silicon correlation.
+
+The rebuild of the reference's per-kernel / per-counter correlator
+(``util/plotting/plot-correlation.py:1-100`` + ``correl_mappings.py:21-100``,
+which compares many counters per kernel per card and reports error +
+correlation + outliers) at HLO-instruction grain: capture a
+``jax.profiler`` trace (xplane) of the live program, extract per-op device
+durations, and correlate them against the timing engine's per-op
+aggregates (:attr:`EngineResult.per_op_cycles`).
+
+This closes the hole the end-to-end number can hide: a 2x-too-fast matmul
+model compensating for a 2x-too-slow DMA model nets out invisible at
+wall-clock grain but lights up here as two top-N mispredicted op classes.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+__all__ = [
+    "OpSilicon",
+    "OpRow",
+    "OpCorrelation",
+    "extract_op_profile",
+    "profile_workload",
+    "correlate_ops",
+]
+
+#: control-flow ops whose engine duration aggregates their bodies — the
+#: bodies' ops are reported individually, so these are excluded
+_CONTROL_OPS = frozenset({"while", "conditional", "call"})
+
+
+@dataclass
+class OpSilicon:
+    """Measured device time for one HLO instruction."""
+
+    name: str
+    count: float = 0.0
+    total_ns: float = 0.0
+
+    @property
+    def avg_ns(self) -> float:
+        return self.total_ns / self.count if self.count else 0.0
+
+
+@dataclass
+class OpRow:
+    """One correlated instruction: simulated vs measured."""
+
+    name: str
+    opcode: str
+    sim_ns: float           # per-occurrence
+    real_ns: float          # per-occurrence
+    sim_count: float
+    real_count: float
+
+    @property
+    def error_pct(self) -> float:
+        if self.real_ns <= 0:
+            return math.inf
+        return 100.0 * (self.sim_ns - self.real_ns) / self.real_ns
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "opcode": self.opcode,
+            "sim_ns": round(self.sim_ns, 1),
+            "real_ns": round(self.real_ns, 1),
+            "sim_count": self.sim_count,
+            "real_count": self.real_count,
+            "error_pct": round(self.error_pct, 2)
+            if math.isfinite(self.error_pct) else None,
+        }
+
+
+@dataclass
+class OpCorrelation:
+    """Full per-op correlation result for one workload."""
+
+    workload: str
+    rows: list[OpRow] = field(default_factory=list)
+    sim_only: list[str] = field(default_factory=list)
+    silicon_only: list[str] = field(default_factory=list)
+    #: fraction of measured device time covered by matched rows
+    matched_time_fraction: float = 0.0
+
+    @property
+    def weighted_abs_error_pct(self) -> float:
+        """Mean |error| weighted by measured time — the headline per-op
+        number (time-weighting keeps 1000 cheap ops from hiding one bad
+        matmul model)."""
+        num = den = 0.0
+        for r in self.rows:
+            if not math.isfinite(r.error_pct):
+                continue
+            w = r.real_ns * r.real_count
+            num += abs(r.error_pct) * w
+            den += w
+        return num / den if den else math.inf
+
+    def worst(self, n: int = 10) -> list[OpRow]:
+        """Top-N mispredictions by absolute time delta (the outlier list of
+        ``plot-correlation.py``)."""
+        finite = [r for r in self.rows if math.isfinite(r.error_pct)]
+        return sorted(
+            finite,
+            key=lambda r: -abs(r.sim_ns - r.real_ns) * r.real_count,
+        )[:n]
+
+    def by_opcode(self) -> dict[str, dict[str, float]]:
+        """Aggregate error per opcode class — names the bad model, not
+        just the bad instruction."""
+        agg: dict[str, dict[str, float]] = {}
+        for r in self.rows:
+            d = agg.setdefault(
+                r.opcode, {"sim_ns": 0.0, "real_ns": 0.0, "ops": 0.0}
+            )
+            d["sim_ns"] += r.sim_ns * r.real_count
+            d["real_ns"] += r.real_ns * r.real_count
+            d["ops"] += 1
+        for d in agg.values():
+            d["error_pct"] = (
+                round(100.0 * (d["sim_ns"] - d["real_ns"]) / d["real_ns"], 2)
+                if d["real_ns"] > 0 else None
+            )
+        return agg
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "workload": self.workload,
+            "weighted_abs_error_pct": round(self.weighted_abs_error_pct, 2)
+            if math.isfinite(self.weighted_abs_error_pct) else None,
+            "matched_time_fraction": round(self.matched_time_fraction, 4),
+            "n_matched": len(self.rows),
+            "worst": [r.to_json() for r in self.worst(10)],
+            "by_opcode": self.by_opcode(),
+            "sim_only": self.sim_only[:20],
+            "silicon_only": self.silicon_only[:20],
+            "rows": [r.to_json() for r in self.rows],
+        }
+
+
+# ---------------------------------------------------------------------------
+# xplane extraction
+# ---------------------------------------------------------------------------
+
+
+def extract_op_profile(xplane_path: str | Path) -> dict[str, OpSilicon]:
+    """Parse an ``.xplane.pb`` file into per-instruction device durations.
+
+    Keeps events that carry an ``hlo_op``/``hlo_module`` stat (XLA op
+    executions on the device or PJRT-CPU thread planes); ``end:`` markers
+    and host-python lines are skipped.  Aggregates by instruction name
+    across occurrences (loop iterations, repeated launches)."""
+    from jax.profiler import ProfileData
+
+    data = ProfileData.from_serialized_xspace(
+        Path(xplane_path).read_bytes()
+    )
+    ops: dict[str, OpSilicon] = {}
+    for plane in data.planes:
+        pname = plane.name or ""
+        if pname.startswith("/host:metadata") or pname == "Task Environment":
+            continue
+        for line in plane.lines:
+            lname = line.name or ""
+            if lname == "python":  # host-side trace, not device time
+                continue
+            for ev in line.events:
+                name = ev.name or ""
+                if not name or name.startswith("end:"):
+                    continue
+                try:
+                    stats = {k: v for k, v in ev.stats}
+                except Exception:
+                    stats = {}
+                if "hlo_op" not in stats and "hlo_module" not in stats:
+                    continue
+                rec = ops.setdefault(name, OpSilicon(name))
+                rec.count += 1.0
+                rec.total_ns += float(ev.duration_ns)
+    return ops
+
+
+def latest_xplane(log_dir: str | Path) -> Path:
+    paths = sorted(
+        glob.glob(str(Path(log_dir) / "**" / "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise FileNotFoundError(f"no .xplane.pb under {log_dir}")
+    return Path(paths[-1])
+
+
+def profile_workload(
+    fn: Callable,
+    args: tuple,
+    *,
+    log_dir: str | Path,
+    warmup: int = 2,
+    iters: int = 3,
+) -> dict[str, OpSilicon]:
+    """Run ``fn`` under ``jax.profiler.trace`` and return per-op device
+    durations (the nvprof-per-kernel pass of ``util/hw_stats``)."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    for _ in range(max(warmup, 1)):
+        out = jitted(*args)
+    jax.block_until_ready(out)
+    with jax.profiler.trace(str(log_dir)):
+        for _ in range(max(iters, 1)):
+            out = jitted(*args)
+        jax.block_until_ready(out)
+    return extract_op_profile(latest_xplane(log_dir))
+
+
+# ---------------------------------------------------------------------------
+# correlation
+# ---------------------------------------------------------------------------
+
+
+def _norm(name: str) -> str:
+    return name.lstrip("%").strip()
+
+
+def correlate_ops(
+    result: "Any",
+    silicon: dict[str, OpSilicon],
+    *,
+    clock_hz: float,
+    workload: str = "workload",
+    real_iters: int = 1,
+    min_real_ns: float = 0.0,
+) -> OpCorrelation:
+    """Match the engine's per-op aggregates against measured durations.
+
+    ``result`` is an :class:`~tpusim.timing.engine.EngineResult` for ONE
+    simulated execution; ``silicon`` aggregates ``real_iters`` executions
+    (counts are normalized per-occurrence on both sides, so the iteration
+    counts need not match)."""
+    corr = OpCorrelation(workload=workload)
+    sil_by_name = {_norm(k): v for k, v in silicon.items()}
+    total_real = sum(s.total_ns for s in sil_by_name.values())
+    matched_real = 0.0
+
+    sim_seen = set()
+    for name, cycles in result.per_op_cycles.items():
+        opcode = result.per_op_opcode.get(name, "?")
+        if opcode in _CONTROL_OPS:
+            continue
+        key = _norm(name)
+        sim_seen.add(key)
+        count = result.per_op_count.get(name, 1.0) or 1.0
+        sim_ns = cycles / clock_hz * 1e9 / count
+        sil = sil_by_name.get(key)
+        if sil is None or sil.avg_ns < min_real_ns:
+            if sil is None and sim_ns > 0:
+                corr.sim_only.append(key)
+            continue
+        matched_real += sil.total_ns
+        corr.rows.append(OpRow(
+            name=key,
+            opcode=opcode,
+            sim_ns=sim_ns,
+            real_ns=sil.avg_ns,
+            sim_count=count,
+            real_count=sil.count / max(real_iters, 1),
+        ))
+    corr.silicon_only = sorted(
+        k for k in sil_by_name if k not in sim_seen
+    )
+    corr.matched_time_fraction = (
+        matched_real / total_real if total_real > 0 else 0.0
+    )
+    corr.rows.sort(key=lambda r: -r.real_ns * r.real_count)
+    return corr
+
+
+def correlate_workload_ops(
+    fn: Callable,
+    args: tuple,
+    *,
+    name: str = "workload",
+    arch: str | None = None,
+    log_dir: str | Path | None = None,
+    iters: int = 3,
+) -> OpCorrelation:
+    """End-to-end: capture + simulate + profile + per-op correlate one
+    workload on the live backend."""
+    import tempfile
+
+    import jax
+
+    from tpusim.timing.arch import detect_arch
+    from tpusim.timing.config import SimConfig, load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.tracer.capture import capture
+
+    cap = capture(fn, *args, name=name)
+    if arch is None:
+        cfg = SimConfig(arch=detect_arch(jax.devices()[0].device_kind))
+    else:
+        cfg = load_config(arch=arch)
+    res = Engine(cfg).run(cap.module)
+
+    log_dir = log_dir or tempfile.mkdtemp(prefix=f"tpusim_prof_{name}_")
+    silicon = profile_workload(fn, args, log_dir=log_dir, iters=iters)
+    return correlate_ops(
+        res, silicon, clock_hz=cfg.arch.clock_hz, workload=name,
+        real_iters=iters,
+    )
+
+
+def write_correl_ops(
+    correlations: list[OpCorrelation], path: str | Path
+) -> Path:
+    """Write the ``correl_ops.json`` artifact (one entry per workload,
+    plus the cross-workload worst-op summary)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    finite = [
+        c.weighted_abs_error_pct for c in correlations
+        if math.isfinite(c.weighted_abs_error_pct)
+    ]
+    doc = {
+        "mean_weighted_abs_error_pct": round(
+            sum(finite) / len(finite), 2
+        ) if finite else None,
+        "workloads": [c.to_json() for c in correlations],
+    }
+    path.write_text(json.dumps(doc, indent=2))
+    return path

@@ -80,6 +80,16 @@ class EngineResult:
     opcode_cycles: dict[str, float] = field(
         default_factory=lambda: defaultdict(float)
     )
+    # per-instruction aggregates (loop bodies scaled by trip count) — the
+    # substrate for per-op silicon correlation (correl_mappings.py's
+    # per-kernel counters, at HLO-instruction grain)
+    per_op_cycles: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    per_op_count: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    per_op_opcode: dict[str, str] = field(default_factory=dict)
     timeline: list[TimelineEvent] = field(default_factory=list)
 
     # -- derived -----------------------------------------------------------
@@ -123,6 +133,11 @@ class EngineResult:
             self.unit_busy_cycles[k] += v * times
         for k, v in other.opcode_cycles.items():
             self.opcode_cycles[k] += v * times
+        for k, v in other.per_op_cycles.items():
+            self.per_op_cycles[k] += v * times
+        for k, v in other.per_op_count.items():
+            self.per_op_count[k] += v * times
+        self.per_op_opcode.update(other.per_op_opcode)
 
     def stats_dict(self) -> dict[str, float]:
         d = {
@@ -443,6 +458,11 @@ class Engine:
         self, result: EngineResult, op: TraceOp, start: float, end: float,
         unit: Unit,
     ) -> None:
+        # per-instruction aggregates are always recorded (cheap dict adds;
+        # per-op correlation needs them even without the full timeline)
+        result.per_op_cycles[op.name] += end - start
+        result.per_op_count[op.name] += 1.0
+        result.per_op_opcode.setdefault(op.name, op.base)
         if not self.record_timeline:
             return
         if len(result.timeline) >= self.max_timeline_events:
