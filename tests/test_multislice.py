@@ -1,0 +1,141 @@
+"""Multi-slice / DCN end-to-end tests (VERDICT r1 #6).
+
+A group whose replica ids span ``chips_per_slice`` crosses slice
+boundaries; the inter-slice portion rides DCN (25GB/s class) instead of
+ICI (90GB/s/link class), so the DCN term must dominate the cost.
+Reference spirit: the fork's multi-GPU tracing path
+(``tracer_tool.cu:442-445``) — which recorded no byte counts at all; here
+the driver prices the recorded groups end-to-end.  Also anchors the
+analytic all-to-all model to the detailed packet simulation (the round-1
+gap: the axis-factored heuristic had no cross-check).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.detailed import DetailedCollectiveModel
+from tpusim.ici.topology import Topology
+from tpusim.ir import CollectiveInfo, CommandKind, PodTrace, TraceCommand
+from tpusim.sim.driver import SimDriver
+from tpusim.timing.config import IciConfig, SimConfig, overlay
+from tpusim.trace.format import load_trace, save_trace
+
+
+def _multislice_pod(n_devices: int = 8, nbytes: int = 64 * 1024 * 1024):
+    """A pod trace with one all-reduce whose group spans all devices."""
+    pod = PodTrace(meta={"num_devices": n_devices})
+    info = CollectiveInfo(
+        "all-reduce", replica_groups=(tuple(range(n_devices)),)
+    )
+    for d in range(n_devices):
+        pod.device(d).commands.append(TraceCommand(
+            kind=CommandKind.COLLECTIVE, device_id=d, nbytes=nbytes,
+            collective=info,
+        ))
+    return pod
+
+
+def test_dcn_term_engages_at_driver_level(tmp_path):
+    """The same trace must cost far more when its group spans two slices
+    (chips_per_slice=4 over an 8-chip group) than on a single slice."""
+    pod = _multislice_pod(8)
+    # round-trip through the on-disk format: this is the fixture path
+    td_path = tmp_path / "trace"
+    save_trace(
+        td_path,
+        modules={},
+        commands=[c for d in pod.devices.values() for c in d.commands],
+        meta=pod.meta,
+    )
+    pod = load_trace(td_path)
+
+    single = SimDriver(SimConfig()).run(pod)
+    multi = SimDriver(overlay(
+        SimConfig(), {"arch": {"ici": {"chips_per_slice": 4}}}
+    )).run(pod)
+
+    # DCN: 2*(S-1)/S * B / 25GB/s with S=2 slices = B/25e9 ~ 2.7ms vs
+    # the ICI ring's ~0.1ms class: at least 3x slower end to end
+    assert multi.cycles > 3.0 * single.cycles
+
+
+def test_dcn_term_matches_closed_form():
+    cfg = IciConfig(chips_per_slice=4, dcn_bandwidth=25e9, dcn_latency=10e-6)
+    topo = Topology(dims=(8,), wrap=(True,))
+    model = CollectiveModel(topo, cfg)
+    payload = 100e6
+    t = model.allreduce_seconds(payload, 8)
+    # 2 slices: 2*(1/2)*B/dcn_bw + dcn_latency*log2(2) + launch
+    expect = payload / 25e9 + 10e-6 + cfg.launch_latency
+    assert t == pytest.approx(expect, rel=0.01)
+
+
+def test_multislice_group_in_detailed_mode_uses_analytic_dcn(tmp_path):
+    """network_mode=detailed must not collapse a multi-slice group: ids
+    >= num_chips alias, so the detailed model defers to the analytic
+    slice/DCN split (round-2 aliasing guard) and the DCN cost survives."""
+    nbytes = 64 * 1024 * 1024
+    pod = PodTrace(meta={"num_devices": 4})
+    # 8 replicas on a 4-chip slice topology: ids 4..7 are the second slice
+    info = CollectiveInfo("all-reduce", replica_groups=(tuple(range(8)),))
+    for d in range(4):
+        pod.device(d).commands.append(TraceCommand(
+            kind=CommandKind.COLLECTIVE, device_id=d, nbytes=nbytes,
+            collective=info,
+        ))
+    base = {"arch": {"ici": {"chips_per_slice": 4}}}
+    ana = SimDriver(overlay(SimConfig(), base)).run(pod)
+    det = SimDriver(overlay(
+        SimConfig(), base, {"arch": {"ici": {"network_mode": "detailed"}}}
+    )).run(pod)
+    assert det.cycles == pytest.approx(ana.cycles, rel=0.01)
+
+
+# -- analytic vs detailed cross-checks --------------------------------------
+
+def _cfg(**kw) -> IciConfig:
+    base = dict(
+        link_bandwidth=100e9, efficiency=1.0, hop_latency=1e-9,
+        launch_latency=0.0, network_mode="detailed",
+    )
+    base.update(kw)
+    return IciConfig(**base)
+
+
+@pytest.mark.parametrize("dims", [(4,), (8,), (4, 4)])
+def test_alltoall_analytic_vs_detailed(dims):
+    """The analytic all-to-all (balanced shortest-path bound per axis)
+    must agree with the packet simulation within a stated tolerance.
+    The detailed model runs above the bound (DOR breaks tie-distance
+    routes one way, unbalancing links) but below 1.6x of it."""
+    n = 1
+    for d in dims:
+        n *= d
+    topo = Topology(dims=dims, wrap=tuple(True for _ in dims))
+    cfg = _cfg()
+    info = CollectiveInfo("all-to-all", replica_groups=(tuple(range(n)),))
+    payload = 64e6
+    t_ana = CollectiveModel(topo, cfg).seconds(info, payload)
+    t_det = DetailedCollectiveModel(topo, cfg).seconds(info, payload)
+    ratio = t_det / t_ana
+    assert 0.75 <= ratio <= 1.6, (dims, t_ana, t_det, ratio)
+
+
+def test_alltoall_analytic_respects_link_load_bound():
+    """The analytic time must never beat the aggregate link-load lower
+    bound (total byte-hops / total directed capacity)."""
+    n = 8
+    topo = Topology(dims=(n,), wrap=(True,))
+    cfg = _cfg(hop_latency=0.0)
+    payload = 64e6
+    t = CollectiveModel(topo, cfg).seconds(
+        CollectiveInfo("all-to-all", replica_groups=(tuple(range(n)),)),
+        payload,
+    )
+    w = cfg.link_bandwidth
+    # mean shortest-path distance on an even ring = n/4
+    byte_hops = n * payload * (n / 4.0)
+    bound = byte_hops / (2 * n * w)
+    assert t >= bound * 0.999

@@ -17,7 +17,8 @@ the group = 2 per torus axis):
 * tree all-reduce:     2·B / (W·D) pipelined, 2·log2(N) hop latencies
 * all-gather:          (N-1)/N · B_full / (W·D)
 * reduce-scatter:      (N-1)/N · B_in / (W·D)
-* all-to-all (ring):   B · N / (8·W·D_axis) per axis, axis-factored
+* all-to-all (ring):   B · N / (8·W) per axis (balanced shortest-path
+  bound over the 2N directed links), axis-factored
 * collective-permute:  B / W + hops · hop_latency
 
 The per-collective time is ``launch_latency + max(bandwidth term, latency
@@ -130,9 +131,11 @@ class CollectiveModel:
             n_ax = min(self.topo.dims[ax], remaining)
             if n_ax <= 1:
                 continue
-            # bidirectional ring all-to-all on this axis: per-(directed-)link
-            # traffic = payload * n_ax / 8
-            t += payload * n_ax / (8.0 * w * 2.0)
+            # balanced bidirectional ring all-to-all on this axis: total
+            # byte-hops = payload * n_ax^2 / 4 (mean shortest-path hop
+            # distance n_ax/4) spread over 2*n_ax directed links of
+            # bandwidth w -> per-link traffic payload * n_ax / 8
+            t += payload * n_ax / (8.0 * w)
             t += (n_ax / 2.0) * self.cfg.hop_latency
             remaining = max(remaining // n_ax, 1)
         if self._spans_dcn(n):
