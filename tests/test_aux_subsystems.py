@@ -199,6 +199,56 @@ def test_checkpoint_resume_partition_with_memcpys():
     )
 
 
+# -- sub-kernel (op-index) checkpoint/resume --------------------------------
+#
+# tiny_mlp entry schedule: [x, w1, w2, dot.1, relu.1, ar-start, ar-done,
+# dot.2] — op index 5 is a clean boundary (nothing in flight), index 6
+# splits the async all-reduce across the halves.
+
+def test_op_checkpoint_resume_partitions_exactly(tiny_mlp):
+    full = Engine(SimConfig()).run(tiny_mlp)
+    first = Engine(
+        overlay(SimConfig(), {"checkpoint_op": 5})
+    ).run(tiny_mlp)
+    rest = Engine(
+        overlay(SimConfig(), {"resume_op": 5})
+    ).run(tiny_mlp)
+    # nothing in flight at op 5: the halves partition the run exactly
+    assert first.cycles + rest.cycles == pytest.approx(full.cycles)
+    assert first.flops + rest.flops == pytest.approx(full.flops)
+    assert first.op_count + rest.op_count == full.op_count
+    assert first.collective_count == 0 and rest.collective_count == 1
+    assert first.unjoined_async == 0 and rest.orphan_async_joins == 0
+
+
+def test_op_checkpoint_across_async_boundary(tiny_mlp):
+    """Splitting between ar-start and ar-done: the checkpoint drains the
+    in-flight collective (barrier), the resume half joins it silently —
+    no orphan/unjoined flags, and the barrier can only add time."""
+    full = Engine(SimConfig()).run(tiny_mlp)
+    first = Engine(
+        overlay(SimConfig(), {"checkpoint_op": 6})
+    ).run(tiny_mlp)
+    rest = Engine(
+        overlay(SimConfig(), {"resume_op": 6})
+    ).run(tiny_mlp)
+    assert first.unjoined_async == 0
+    assert rest.orphan_async_joins == 0
+    assert first.collective_count == 1 and rest.collective_count == 0
+    assert first.cycles + rest.cycles >= full.cycles * 0.999
+    assert first.flops + rest.flops == pytest.approx(full.flops)
+
+
+def test_op_checkpoint_inside_driver_replay():
+    """The op knobs compose with the kernel-level driver replay."""
+    from tpusim.sim.driver import SimDriver as _SD
+
+    pod = _pod(2)
+    full = _SD(SimConfig()).run(pod)
+    half = _SD(overlay(SimConfig(), {"checkpoint_op": 5})).run(pod)
+    assert 0 < half.cycles < full.cycles
+
+
 # -- debugger ---------------------------------------------------------------
 
 def _run_debugger(tiny_mlp, commands: str) -> str:

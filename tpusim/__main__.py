@@ -28,6 +28,10 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         overlays.append({"resume_kernel": args.resume_kernel})
     if args.checkpoint_kernel:
         overlays.append({"checkpoint_kernel": args.checkpoint_kernel})
+    if args.resume_op:
+        overlays.append({"resume_op": args.resume_op})
+    if args.checkpoint_op:
+        overlays.append({"checkpoint_op": args.checkpoint_op})
     if args.network_mode:
         overlays.append({"arch": {"ici": {"network_mode": args.network_mode}}})
     report = simulate_trace(args.trace, arch=args.arch, overlays=overlays)
@@ -259,6 +263,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="fast-forward the first N kernel launches")
     ps.add_argument("--checkpoint-kernel", type=int, default=0,
                     help="stop the replay after N kernel launches")
+    ps.add_argument("--resume-op", type=int, default=0,
+                    help="fast-forward the first N entry ops inside each "
+                         "module replay (sub-kernel resume)")
+    ps.add_argument("--checkpoint-op", type=int, default=0,
+                    help="stop each module replay after N entry ops "
+                         "(sub-kernel checkpoint; drains in-flight async)")
     ps.add_argument("--network-mode", default=None,
                     choices=["analytic", "detailed"],
                     help="ICI model: closed-form schedules or per-packet "

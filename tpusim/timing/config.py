@@ -191,6 +191,17 @@ class SimConfig:
     # the replay after N launches and records the stop point
     resume_kernel: int = 0
     checkpoint_kernel: int = 0
+    # sub-kernel checkpoint/resume at ENTRY-OP granularity inside one
+    # module replay (reference: per-instruction functional checkpoint,
+    # abstract_hardware_model.h:1280-1288).  checkpoint_op=K stops the
+    # entry walk after K scheduled ops and drains in-flight transfers (a
+    # state snapshot cannot leave DMA mid-flight); resume_op=K
+    # fast-forwards the first K ops, treating transfers they started as
+    # already complete.  The boundary is therefore a barrier: for a
+    # schedule with nothing in flight at op K the two halves partition the
+    # full run exactly.
+    resume_op: int = 0
+    checkpoint_op: int = 0
     # model HBM bandwidth sharing between async DMA and compute (the
     # FR-FCFS/queueing slot of the reference, dram_sched.h:41 — here a
     # fair-share split when both stream concurrently)

@@ -264,8 +264,21 @@ class Engine:
         hbm_bpc = a.hbm_bytes_per_cycle
         contend = self.config.model_hbm_contention
         overlap = self.config.overlap_collectives
+        # op-granularity checkpoint/resume applies to the entry walk only
+        resume_op = self.config.resume_op if depth == 0 else 0
+        checkpoint_op = self.config.checkpoint_op if depth == 0 else 0
+        skipped_starts: set[str] = set()
 
-        for op in comp.ops:
+        for op_index, op in enumerate(comp.ops):
+            if checkpoint_op and op_index >= checkpoint_op:
+                break
+            if resume_op and op_index < resume_op:
+                # fast-forward already-simulated work; remember async
+                # starts so their done-ops join silently (the transfer
+                # completed before the checkpoint barrier)
+                if op.is_async_start:
+                    skipped_starts.add(op.name)
+                continue
             base = op.base
 
             # ---- control flow: recurse ---------------------------------
@@ -326,6 +339,10 @@ class Engine:
             # ---- async joins -------------------------------------------
             if op.is_async_done:
                 src = op.operands[0] if op.operands else None
+                if src in skipped_starts:
+                    # started before the resume point: complete by now
+                    result.op_count += 1
+                    continue
                 if src not in pending:
                     result.orphan_async_joins += 1
                 finish = pending.pop(src, t)
@@ -446,8 +463,15 @@ class Engine:
 
         # drain: the program isn't done until pending transfers complete;
         # leftovers indicate a truncated/corrupt trace (async-start with no
-        # join) — surfaced like the reference's deadlock check
-        result.unjoined_async += len(pending)
+        # join) — surfaced like the reference's deadlock check.  At an
+        # op-granularity checkpoint the drain is the barrier itself: the
+        # in-flight transfers are legitimate (their done-ops are in the
+        # resume half), not trace corruption.
+        stopped_at_checkpoint = (
+            checkpoint_op and len(comp.ops) > checkpoint_op
+        )
+        if not stopped_at_checkpoint:
+            result.unjoined_async += len(pending)
         for finish in pending.values():
             t = max(t, finish)
         return t
