@@ -44,12 +44,24 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_sets(items: list[str] | None) -> dict:
+    """``--set k=v`` overrides (ints/floats/json parsed, else string)."""
+    out = {}
+    for item in items or []:
+        k, _, v = item.partition("=")
+        try:
+            out[k] = json.loads(v)
+        except (json.JSONDecodeError, ValueError):
+            out[k] = v
+    return out
+
+
 def _cmd_capture(args: argparse.Namespace) -> int:
     from tpusim.models import get_workload
     from tpusim.tracer.capture import capture_to_dir
 
     wl = get_workload(args.workload)
-    fn, wl_args = wl.build()
+    fn, wl_args = wl.build(**_parse_sets(args.set))
     capture_to_dir(
         args.out, fn, *wl_args, name=wl.name, launches=args.launches
     )
@@ -64,6 +76,27 @@ def _cmd_capture(args: argparse.Namespace) -> int:
         print(f"{len(paths)} buffer snapshots in {args.out}/checkpoint_files")
     print(f"trace written to {args.out}")
     return 0
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    from tpusim.harness.runner import run_suite
+
+    rows = run_suite(
+        args.suite,
+        [c for c in args.configs.split(",") if c],
+        args.out,
+        trace_root=args.traces,
+        yaml_path=args.yaml,
+        capture_missing=args.capture,
+        parallel=args.parallel,
+        power=args.power,
+        monitor_interval_s=args.monitor_interval,
+    )
+    failed = rows.get("__failed__", {}).get("runs", [])
+    ok = {k: v for k, v in rows.items() if k != "__failed__"}
+    print(f"tpusim run: {len(ok)} runs scraped to {args.out}/stats.csv"
+          + (f"; FAILED: {failed}" if failed else ""))
+    return 1 if failed else 0
 
 
 def _cmd_correlate_ops(args: argparse.Namespace) -> int:
@@ -282,7 +315,32 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("--snapshot", action="store_true",
                     help="also dump every output buffer per launch to "
                          "<out>/checkpoint_files/ (silicon checkpoints)")
+    pc.add_argument("--set", action="append", metavar="K=V",
+                    help="workload builder parameter override(s)")
     pc.set_defaults(fn=_cmd_capture)
+
+    pr = sub.add_parser(
+        "run",
+        help="run a benchmark suite across configs (run_simulations.py): "
+             "fabricate run dirs, execute, monitor, scrape to stats.csv",
+    )
+    pr.add_argument("-B", "--suite", required=True,
+                    help="suite name (built-in registry group or YAML)")
+    pr.add_argument("-C", "--configs", required=True,
+                    help="comma-separated arch[+named] list, e.g. "
+                         "v5p,v5e,v5p+dcn")
+    pr.add_argument("-o", "--out", default="runs",
+                    help="output root (run dirs, jobs.json, stats.csv)")
+    pr.add_argument("--traces", default=None,
+                    help="trace root (default <out>/traces)")
+    pr.add_argument("--yaml", default=None,
+                    help="suite/config YAML (define-all-apps equivalent)")
+    pr.add_argument("--capture", action="store_true",
+                    help="capture missing traces on the live backend first")
+    pr.add_argument("--parallel", type=int, default=None)
+    pr.add_argument("--power", action="store_true")
+    pr.add_argument("--monitor-interval", type=float, default=10.0)
+    pr.set_defaults(fn=_cmd_run)
 
     pco = sub.add_parser(
         "correlate-ops",

@@ -103,14 +103,25 @@ class ProcMan:
             self._start(j)
         return any(j.status in ("pending", "running") for j in self.jobs)
 
-    def run(self, poll_s: float = 0.2, timeout_s: float | None = None) -> bool:
-        """Run until all jobs finish.  Returns True if all succeeded."""
+    def run(
+        self,
+        poll_s: float = 0.2,
+        timeout_s: float | None = None,
+        on_tick=None,
+    ) -> bool:
+        """Run until all jobs finish.  Returns True if all succeeded.
+        ``on_tick(self)`` is called once per poll — the job_status.py
+        monitoring hook."""
         deadline = time.time() + timeout_s if timeout_s else None
         while self.step():
+            if on_tick is not None:
+                on_tick(self)
             if deadline and time.time() > deadline:
                 self.kill_all()
                 return False
             time.sleep(poll_s)
+        if on_tick is not None:
+            on_tick(self)
         return all(j.status == "done" for j in self.jobs)
 
     def kill_all(self) -> None:

@@ -1,27 +1,33 @@
 """Experiment runner — the rebuild of ``util/job_launching/
-run_simulations.py``.
+run_simulations.py`` + ``job_status.py`` + ``monitor_func_test.py``.
 
 The reference fabricates a run directory per (benchmark, config): symlinked
 traces, concatenated config overlays, then submits jobs
 (``ConfigurationSpec.run``, ``run_simulations.py:83-168``; config append
-``:303-328``).  Ours does the same with typed pieces: a run dir per
-(workload-trace, arch+overlay), a composed ``sim.config`` flag file, a
-``python -m tpusim simulate`` job per run launched through
-:class:`tpusim.harness.procman.ProcMan`, and scraping via
+``:303-328``), polls their status (``job_status.py``), and fails loudly on
+logs missing the exit sentinel (``monitor_func_test.py:66-75``).  Ours does
+the same with typed pieces: a suite×config matrix from
+:mod:`tpusim.harness.suites`, a run dir per cell with a composed
+``sim.config`` flag file, ``python -m tpusim simulate`` jobs through
+:class:`tpusim.harness.procman.ProcMan` (capture jobs first for missing
+traces), a live status monitor, and scraping via
 :mod:`tpusim.harness.scrape`.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
+from typing import Any
 
 from tpusim.harness.procman import ProcMan
-from tpusim.harness.scrape import scrape_run_dirs
+from tpusim.harness.scrape import scrape_run_dirs, write_csv
 
-__all__ = ["RunSpec", "run_experiments"]
+__all__ = ["RunSpec", "run_experiments", "run_suite", "overlay_to_flag_lines"]
 
 
 @dataclass
@@ -38,6 +44,19 @@ class RunSpec:
     def run_name(self) -> str:
         base = self.name or Path(self.trace).name
         return f"{base}__{self.arch}"
+
+
+def overlay_to_flag_lines(d: dict[str, Any], prefix: str = "") -> list[str]:
+    """Flatten a nested overlay dict into reference-style ``-key value``
+    flag lines (dotted paths reach nested configs)."""
+    lines: list[str] = []
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            lines.extend(overlay_to_flag_lines(v, prefix=f"{key}."))
+        else:
+            lines.append(f"-{key} {json.dumps(v)}")
+    return lines
 
 
 def _fabricate_run_dir(root: Path, spec: RunSpec) -> Path:
@@ -57,14 +76,44 @@ def _fabricate_run_dir(root: Path, spec: RunSpec) -> Path:
     return run_dir
 
 
+def _monitor_printer(interval_s: float):
+    """Periodic status line — the ``job_status.py`` polling loop."""
+    last = [0.0]
+
+    def on_tick(pm: ProcMan) -> None:
+        now = time.time()
+        if now - last[0] < interval_s:
+            return
+        last[0] = now
+        s = pm.status_summary()
+        running = [
+            f"{Path(j.log_path or str(j.job_id)).parent.name}"
+            f"({now - (j.started_at or now):.0f}s)"
+            for j in pm.jobs if j.status == "running"
+        ]
+        print(
+            f"tpusim run: {s.get('done', 0)} done, "
+            f"{s.get('failed', 0)} failed, {s.get('running', 0)} running, "
+            f"{s.get('pending', 0)} pending"
+            + (f"  [{', '.join(running[:6])}]" if running else ""),
+            flush=True,
+        )
+
+    return on_tick
+
+
 def run_experiments(
     specs: list[RunSpec],
     out_root: str | Path,
     parallel: int | None = None,
     timeout_s: float | None = 1800,
+    monitor_interval_s: float | None = None,
+    csv_path: str | Path | None = None,
 ) -> dict[str, dict[str, object]]:
-    """Fabricate run dirs, execute all cells, scrape results.  Returns
-    run-name → stats (plus '__failed__' listing)."""
+    """Fabricate run dirs, execute all cells, monitor, scrape results.
+    Returns run-name → stats (plus '__failed__' listing); also writes
+    ``jobs.json`` (status DB), ``failures.json`` (sentinel audit) and
+    optionally a stats CSV."""
     out_root = Path(out_root)
     pm = ProcMan(parallel=parallel)
     for spec in specs:
@@ -78,6 +127,109 @@ def run_experiments(
         if spec.power:
             cmd.append("--power")
         pm.submit(cmd, log_path=run_dir / "run.log")
-    pm.run(timeout_s=timeout_s)
+    on_tick = _monitor_printer(monitor_interval_s) if monitor_interval_s \
+        else None
+    pm.run(timeout_s=timeout_s, on_tick=on_tick)
     pm.dump_state(out_root / "jobs.json")
-    return scrape_run_dirs(out_root, "**/run.log")
+    rows = scrape_run_dirs(out_root, "**/run.log")
+
+    # sentinel audit — a job that exited 0 but never printed the exit
+    # sentinel is still a failure (monitor_func_test.py:66-75)
+    failures = []
+    for j in pm.jobs:
+        ok_log = j.log_path and Path(j.log_path).exists() and (
+            "TPUSIM: *** exit detected ***" in Path(j.log_path).read_text()
+        )
+        if j.status != "done" or not ok_log:
+            failures.append({
+                "job_id": j.job_id, "status": j.status,
+                "returncode": j.returncode, "log": j.log_path,
+                "sentinel": bool(ok_log),
+            })
+    (out_root / "failures.json").write_text(json.dumps(failures, indent=2))
+    if csv_path:
+        write_csv(rows, csv_path)
+    return rows
+
+
+def run_suite(
+    suite: str,
+    configs: list[str],
+    out_root: str | Path,
+    *,
+    trace_root: str | Path | None = None,
+    yaml_path: str | Path | None = None,
+    capture_missing: bool = False,
+    parallel: int | None = None,
+    power: bool = False,
+    timeout_s: float | None = 1800,
+    monitor_interval_s: float | None = 10.0,
+) -> dict[str, dict[str, object]]:
+    """The ``tpusim run -B suite -C v5p,v5e`` flow: resolve the suite,
+    locate (or capture) each workload's trace, fabricate the suite×config
+    matrix, run with monitoring, and emit ``stats.csv``.
+
+    ``configs`` items are ``arch`` or ``arch+named`` where ``named`` is a
+    config from the YAML ``configs:`` section."""
+    from tpusim.harness.suites import load_named_configs, load_suite
+
+    out_root = Path(out_root)
+    out_root.mkdir(parents=True, exist_ok=True)
+    entries = load_suite(suite, yaml_path)
+    named = load_named_configs(yaml_path)
+
+    trace_root = Path(trace_root) if trace_root else out_root / "traces"
+    trace_root.mkdir(parents=True, exist_ok=True)
+
+    # phase 1: capture jobs for missing traces (needs a live backend)
+    missing = [
+        e for e in entries if not (trace_root / e.run_name).is_dir()
+    ]
+    if missing:
+        if not capture_missing:
+            raise FileNotFoundError(
+                f"no trace for {[e.run_name for e in missing]} under "
+                f"{trace_root}; pass capture_missing=True (CLI: --capture) "
+                f"or pre-capture with 'tpusim capture'"
+            )
+        cap_pm = ProcMan(parallel=parallel)
+        for e in missing:
+            cmd = [
+                sys.executable, "-m", "tpusim", "capture", e.workload,
+                str(trace_root / e.run_name),
+                "--launches", str(e.launches),
+            ]
+            for k, v in e.params.items():
+                cmd += ["--set", f"{k}={v}"]
+            cap_pm.submit(cmd, log_path=trace_root / f"{e.run_name}.capture.log")
+        on_tick = _monitor_printer(monitor_interval_s) \
+            if monitor_interval_s else None
+        if not cap_pm.run(timeout_s=timeout_s, on_tick=on_tick):
+            bad = [j.log_path for j in cap_pm.jobs if j.status != "done"]
+            raise RuntimeError(f"capture phase failed: {bad}")
+
+    # phase 2: the simulation matrix
+    specs: list[RunSpec] = []
+    for e in entries:
+        for c in configs:
+            arch, _, extra = c.partition("+")
+            lines: list[str] = []
+            if extra:
+                if extra not in named:
+                    raise KeyError(
+                        f"unknown named config {extra!r}; yaml has "
+                        f"{sorted(named)}"
+                    )
+                lines = overlay_to_flag_lines(named[extra])
+            specs.append(RunSpec(
+                trace=trace_root / e.run_name,
+                arch=arch,
+                overlays=lines,
+                name=f"{e.run_name}__{extra}" if extra else e.run_name,
+                power=power,
+            ))
+    return run_experiments(
+        specs, out_root, parallel=parallel, timeout_s=timeout_s,
+        monitor_interval_s=monitor_interval_s,
+        csv_path=out_root / "stats.csv",
+    )
