@@ -33,7 +33,10 @@ def test_full_utilization_lands_near_tdp():
     from tpusim.timing.arch import arch_preset
     from tpusim.timing.engine import EngineResult
 
-    for gen, lo, hi in (("v5e", 100, 300), ("v5p", 250, 700)):
+    # upper bounds allow theoretical-100%-utilization draw above the TDP
+    # class (the fitted anchors put 0.65 MXU utilization AT the TDP, so
+    # an unachievable 100% legitimately projects past it)
+    for gen, lo, hi in (("v5e", 100, 350), ("v5p", 250, 800)):
         arch = arch_preset(gen)
         res = EngineResult(
             cycles=arch.clock_hz, seconds=1.0,
@@ -83,10 +86,12 @@ def test_dvfs_scaling_quadratic():
     # HBM/SerDes rails are not on the core voltage plane
     assert down.hbm_pj_per_byte == base.hbm_pj_per_byte
     assert down.ici_pj_per_byte == base.ici_pj_per_byte
-    # PowerModel applies the scale
+    # PowerModel applies the scale (to the fitted coefficients, which
+    # take precedence over the preset when committed)
+    unscaled = PowerModel("v5p").coeffs
     m = PowerModel("v5p", dvfs_scale=0.8)
     assert m.coeffs.mxu_pj_per_flop == pytest.approx(
-        base.mxu_pj_per_flop * 0.64
+        unscaled.mxu_pj_per_flop * 0.64
     )
 
 
@@ -121,3 +126,91 @@ def test_power_timeline_tracks_utilization():
     assert tl[2]["watts"] == pytest.approx(static)
     # full-power MXU on v5p should land in the hundreds of watts
     assert 100 < tl[0]["watts"] < 1500
+
+
+# -- power validation: telemetry hook + coefficient fit (VERDICT r1 #5) -----
+
+def test_fit_recovers_known_coefficients():
+    """A well-determined synthetic sample set must recover the generating
+    coefficients (the quadprog_solver.m property)."""
+    from tpusim.power.telemetry import (
+        PowerSample, RATE_KEYS, fit_power_coefficients,
+    )
+    from tpusim.power.model import POWER_PRESETS
+
+    truth = POWER_PRESETS["v5e"]
+    coefs = dict(zip(RATE_KEYS, (
+        truth.mxu_pj_per_flop, truth.vpu_pj_per_flop, truth.sfu_pj_per_op,
+        truth.hbm_pj_per_byte, truth.vmem_pj_per_byte, truth.ici_pj_per_byte,
+    )))
+    static = truth.static_watts + truth.idle_clock_watts
+    scale = {  # plausible absolute event rates
+        "mxu_flops": 4e14, "vpu_flops": 7e12, "transcendentals": 9e11,
+        "hbm_bytes": 2.7e12, "vmem_bytes": 2.7e13, "ici_bytes": 5e11,
+    }
+    samples = [PowerSample("idle", static, {})]
+    # one sample per rate key at full scale, plus two mixes
+    for k in RATE_KEYS:
+        rates = {k: scale[k]}
+        w = static + coefs[k] * scale[k] * 1e-12
+        samples.append(PowerSample(f"only_{k}", w, rates))
+    mix = {k: 0.5 * scale[k] for k in RATE_KEYS}
+    samples.append(PowerSample(
+        "mix", static + sum(coefs[k] * mix[k] * 1e-12 for k in RATE_KEYS),
+        mix,
+    ))
+    fit = fit_power_coefficients(samples, "v5e", prior_weight=1e-4)
+    assert fit.mxu_pj_per_flop == pytest.approx(
+        truth.mxu_pj_per_flop, rel=0.05
+    )
+    assert fit.hbm_pj_per_byte == pytest.approx(
+        truth.hbm_pj_per_byte, rel=0.05
+    )
+    assert fit.static_watts + fit.idle_clock_watts == pytest.approx(
+        static, rel=0.02
+    )
+
+
+@pytest.mark.parametrize("arch", ["v5e", "v5p"])
+def test_fitted_coefficients_match_anchors_within_band(arch):
+    """The COMMITTED fitted coefficients must reproduce every anchor
+    operating point within the stated +/-16% band."""
+    from tpusim.power.telemetry import (
+        RATE_KEYS, _COEF_FIELDS, anchor_samples, load_fitted,
+    )
+
+    c = load_fitted(arch)
+    assert c is not None, f"tpusim/power/fitted/{arch}.json not committed"
+    for s in anchor_samples(arch):
+        watts = sum(
+            getattr(c, f) * s.rates.get(k, 0.0) * 1e-12
+            for f, k in zip(_COEF_FIELDS, RATE_KEYS)
+        ) + c.static_watts + c.idle_clock_watts
+        err = abs(watts - s.watts) / s.watts
+        assert err < 0.16, (arch, s.name, watts, s.watts)
+
+
+def test_power_model_prefers_fitted_coefficients():
+    from tpusim.power.model import PowerModel
+    from tpusim.power.telemetry import load_fitted
+
+    fitted = load_fitted("v5e")
+    assert PowerModel("v5e").coeffs == fitted
+
+
+def test_tune_power_writes_fitted_json(tmp_path):
+    from tpusim.harness.tuner import tune_power
+    import json as _json
+
+    p = tune_power("v5e", out_dir=tmp_path)
+    doc = _json.loads(p.read_text())
+    assert doc["name"] == "v5e"
+    assert doc["meta"]["source"] in ("anchors", "telemetry")
+    assert doc["coefficients"]["static_watts"] > 0
+
+
+def test_telemetry_hook_returns_none_or_positive():
+    from tpusim.power.telemetry import read_power_watts
+
+    w = read_power_watts()
+    assert w is None or w > 0

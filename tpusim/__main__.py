@@ -230,10 +230,14 @@ def _cmd_aerial(args: argparse.Namespace) -> int:
 
 
 def _cmd_tune(args: argparse.Namespace) -> int:
-    from tpusim.harness.tuner import tune, write_overlay
+    from tpusim.harness.tuner import tune, tune_power, write_overlay
 
     import dataclasses
 
+    if args.power:
+        path = tune_power(args.arch or "v5e", out_dir=args.out)
+        print(f"fitted power coefficients written to {path}")
+        return 0
     result = tune(args.arch)
     print(json.dumps(dataclasses.asdict(result), indent=2))
     if args.out:
@@ -362,6 +366,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     pt.add_argument("--arch", default=None)
     pt.add_argument("--out", default=None, help="write a config overlay here")
+    pt.add_argument("--power", action="store_true",
+                    help="fit power coefficients instead (telemetry when "
+                         "available, anchor fixtures otherwise)")
     pt.set_defaults(fn=_cmd_tune)
 
     pw = sub.add_parser("workloads", help="list registered workloads")

@@ -140,7 +140,14 @@ class PowerModel:
         dvfs_scale: float = 1.0,
     ):
         if isinstance(coeffs, str):
-            coeffs = POWER_PRESETS.get(coeffs, PowerCoefficients(name=coeffs))
+            # fitted coefficients (committed by the power-validation fit,
+            # tpusim/power/fitted/<name>.json) take precedence over the
+            # first-principles presets
+            from tpusim.power.telemetry import load_fitted
+
+            coeffs = load_fitted(coeffs) or POWER_PRESETS.get(
+                coeffs, PowerCoefficients(name=coeffs)
+            )
         if dvfs_scale != 1.0:
             coeffs = coeffs.scaled(dvfs_scale)
         self.coeffs = coeffs
