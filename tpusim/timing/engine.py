@@ -222,7 +222,11 @@ class Engine:
         result = EngineResult()
         spill_frac = 1.0
         if self.config.model_vmem_capacity:
-            resident = _vmem_resident_bytes(module)
+            # lazy modules provide a raw-text S(1) scan so the capacity
+            # check doesn't force a full parse of every computation
+            fast = getattr(module, "vmem_resident_bytes", None)
+            resident = fast() if callable(fast) \
+                else _vmem_resident_bytes(module)
             result.vmem_resident_bytes = resident
             cap = float(self.arch.vmem_bytes)
             if resident > cap > 0:

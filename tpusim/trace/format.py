@@ -56,7 +56,12 @@ class TraceDir:
     def module_names(self) -> list[str]:
         if not self.modules_dir.is_dir():
             return []
-        return sorted(p.stem for p in self.modules_dir.glob("*.hlo"))
+        names = {p.stem for p in self.modules_dir.glob("*.hlo")}
+        names.update(
+            p.name[: -len(".hlo.gz")]
+            for p in self.modules_dir.glob("*.hlo.gz")
+        )
+        return sorted(names)
 
 
 # ---------------------------------------------------------------------------
@@ -136,13 +141,25 @@ def parse_commandlist(path: str | Path) -> list[TraceCommand]:
 # ---------------------------------------------------------------------------
 
 
+#: modules at or above this text size are stored gzipped (compress="auto")
+COMPRESS_THRESHOLD_BYTES = 1 * 1024 * 1024
+
+
 def save_trace(
     path: str | Path,
     modules: dict[str, str],
     commands: list[TraceCommand],
     meta: dict | None = None,
+    compress: bool | str = "auto",
 ) -> TraceDir:
-    """Write a trace directory.  ``modules`` maps module name → HLO text."""
+    """Write a trace directory.  ``modules`` maps module name → HLO text.
+
+    ``compress``: True = always gzip module text, False = never, "auto" =
+    gzip modules above :data:`COMPRESS_THRESHOLD_BYTES` (optimized HLO for
+    large models is 100s of MB and compresses ~10x — the
+    ``trace_parser.cc:86-125`` xz-pipe equivalent)."""
+    import gzip
+
     path = Path(path)
     (path / "modules").mkdir(parents=True, exist_ok=True)
     meta = dict(meta or {})
@@ -151,8 +168,18 @@ def save_trace(
         json.dump(meta, f, indent=2, default=str)
     for name, text in modules.items():
         safe = name.replace(os.sep, "_")
-        with open(path / "modules" / f"{safe}.hlo", "w") as f:
-            f.write(text)
+        gz = compress is True or (
+            compress == "auto" and len(text) >= COMPRESS_THRESHOLD_BYTES
+        )
+        if gz:
+            with gzip.open(
+                path / "modules" / f"{safe}.hlo.gz", "wt",
+                compresslevel=6,
+            ) as f:
+                f.write(text)
+        else:
+            with open(path / "modules" / f"{safe}.hlo", "w") as f:
+                f.write(text)
     with open(path / "commandlist.jsonl", "w") as f:
         for cmd in commands:
             f.write(json.dumps(command_to_json(cmd)) + "\n")
@@ -175,16 +202,30 @@ def load_trace(path: str | Path) -> PodTrace:
         with open(meta_path) as f:
             meta = json.load(f)
 
+    from tpusim.trace.lazy import LAZY_THRESHOLD_BYTES, parse_hlo_module_lazy
     from tpusim.trace.native import parse_hlo_module_fast
 
     pod = PodTrace(meta=meta)
     modules_dir = path / "modules"
     if modules_dir.is_dir():
+        import gzip
+
+        entries: list[tuple[str, str]] = []
         for mp in sorted(modules_dir.glob("*.hlo")):
-            mod = parse_hlo_module_fast(mp.read_text(), name_hint=mp.stem)
+            entries.append((mp.stem, mp.read_text()))
+        for mp in sorted(modules_dir.glob("*.hlo.gz")):
+            with gzip.open(mp, "rt") as f:
+                entries.append((mp.name[: -len(".hlo.gz")], f.read()))
+        for key, text in entries:
+            # large modules parse lazily: the engine only materializes the
+            # computations its schedule walk actually reaches
+            if len(text) >= LAZY_THRESHOLD_BYTES:
+                mod = parse_hlo_module_lazy(text, name_hint=key)
+            else:
+                mod = parse_hlo_module_fast(text, name_hint=key)
             # file name is the trace key; HloModule header name may differ
-            pod.modules[mp.stem] = mod
-            mod.meta.setdefault("trace_key", mp.stem)
+            pod.modules[key] = mod
+            mod.meta.setdefault("trace_key", key)
 
     cl = path / "commandlist.jsonl"
     if cl.exists():

@@ -59,11 +59,19 @@ class Capture:
     @property
     def module(self) -> ModuleTrace:
         if self._module is None:
+            from tpusim.trace.lazy import (
+                LAZY_THRESHOLD_BYTES, parse_hlo_module_lazy,
+            )
             from tpusim.trace.native import parse_hlo_module_fast
 
-            self._module = parse_hlo_module_fast(
-                self.hlo_text, name_hint=self.name
-            )
+            if len(self.hlo_text) >= LAZY_THRESHOLD_BYTES:
+                self._module = parse_hlo_module_lazy(
+                    self.hlo_text, name_hint=self.name
+                )
+            else:
+                self._module = parse_hlo_module_fast(
+                    self.hlo_text, name_hint=self.name
+                )
             self._module.meta.update(self.meta)
         return self._module
 
