@@ -115,3 +115,46 @@ def test_bench_child_runs_on_cpu_mesh(cpu_mesh_runner):
     )
     out = cpu_mesh_runner(code, n_devices=1)
     assert "CHILD_OK" in out
+
+
+@pytest.mark.slow
+def test_bench_fixture_loop_closes(tmp_path, cpu_mesh_runner):
+    """A live child run must save replayable silicon fixtures: child
+    (forced fixture save) -> manifest + trace -> fixture_main produces a
+    numeric headline value with no backend at all."""
+    import os as _os
+    import subprocess as _sp
+
+    fx = tmp_path / "silicon"
+    code = (
+        "import json, bench\n"
+        "bench.SUITE = [('matmul_chain', {'m': 256, 'k': 256, 'depth': 2}, 2)]\n"
+        "rc = bench.child_main()\n"
+        "assert rc == 0\n"
+        f"m = json.loads(open({str(fx / 'manifest.json')!r}).read())\n"
+        "assert m['workloads'][0]['name'] == 'matmul_chain'\n"
+        "assert m['workloads'][0]['real_seconds'] > 0\n"
+        "print('FIXTURES_SAVED')\n"
+    )
+    env = dict(_os.environ)
+    env.update({
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "TPUSIM_BENCH_FIXTURES": str(fx),
+        "TPUSIM_BENCH_SAVE_FIXTURES": "force",
+        "TPUSIM_BENCH_REPORT": "",  # skip the report/profile pass
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = _sp.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=600, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "FIXTURES_SAVED" in proc.stdout
+
+    # offline replay: no jax, just the engine vs the committed times
+    import bench
+
+    rc = bench.fixture_main(fixture_dir=fx)
+    assert rc == 0

@@ -114,10 +114,14 @@ def correlate_workload(
     n_steps: int = 16,
     arch: str | None = None,
     iters: int = 3,
+    fixture_dir: Any | None = None,
 ) -> CorrelationPoint:
     """Capture, simulate, and silicon-time one workload; returns the point.
 
-    ``arch=None`` auto-detects from the local device kind."""
+    ``arch=None`` auto-detects from the local device kind.  With
+    ``fixture_dir`` set, the captured trace is also written to
+    ``<fixture_dir>/<name>`` so the measurement can be replayed offline
+    (bench.py's silicon-fixture fallback)."""
     import jax
 
     from tpusim.timing.arch import detect_arch
@@ -128,6 +132,20 @@ def correlate_workload(
     looped = loopify(fn, n_steps)
 
     cap = capture(looped, *args, name=name)
+    if fixture_dir is not None:
+        from pathlib import Path
+
+        from tpusim.ir import CommandKind, TraceCommand
+        from tpusim.trace.format import save_trace
+
+        save_trace(
+            Path(fixture_dir) / name,
+            modules={name: cap.hlo_text},
+            commands=[TraceCommand(
+                kind=CommandKind.KERNEL_LAUNCH, module=name,
+            )],
+            meta=cap.meta,
+        )
     if arch is None:
         cfg = SimConfig(arch=detect_arch(jax.devices()[0].device_kind))
     else:
