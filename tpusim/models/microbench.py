@@ -275,6 +275,41 @@ def build_embedding_lookup(vocab: int, dim: int, lookups: int, dtype: str):
 
 
 @register(
+    "dynamic_loop",
+    description="data-dependent while loop (Newton sqrt to convergence) — "
+    "trip count NOT statically known; exercises the engine's "
+    "default_loop_trip_count fallback and its unknown_trip_loops flag",
+    suite="ubench",
+    elems=256 * 1024, tol=1e-4,
+)
+def build_dynamic_loop(elems: int, tol: float):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    a = jax.random.uniform(
+        jax.random.PRNGKey(0), (elems,), jnp.float32, 0.5, 4.0
+    )
+
+    def f(a):
+        def cond(carry):
+            x, err = carry
+            return err > tol
+
+        def body(carry):
+            x, _ = carry
+            x = 0.5 * (x + a / x)          # Babylonian sqrt step
+            err = jnp.max(jnp.abs(x * x - a))
+            return x, err
+
+        x0 = jnp.ones_like(a)
+        x, _ = lax.while_loop(cond, body, (x0, jnp.float32(jnp.inf)))
+        return x
+
+    return f, (a,)
+
+
+@register(
     "lstm_layer",
     description="LSTM layer over a sequence (scan of gate matmuls — the "
     "DeepBench RNN slot)",

@@ -74,6 +74,7 @@ class EngineResult:
     orphan_async_joins: int = 0     # -done with no matching -start
     unjoined_async: int = 0         # -start never joined before comp end
     unknown_trip_loops: int = 0     # while loops with unresolvable bounds
+    worst_case_branches: int = 0    # conditionals timed at their worst arm
     unit_busy_cycles: dict[str, float] = field(
         default_factory=lambda: defaultdict(float)
     )
@@ -129,6 +130,7 @@ class EngineResult:
         self.orphan_async_joins += int(other.orphan_async_joins * times)
         self.unjoined_async += int(other.unjoined_async * times)
         self.unknown_trip_loops += int(other.unknown_trip_loops * times)
+        self.worst_case_branches += int(other.worst_case_branches * times)
         for k, v in other.unit_busy_cycles.items():
             self.unit_busy_cycles[k] += v * times
         for k, v in other.opcode_cycles.items():
@@ -160,6 +162,7 @@ class EngineResult:
             "orphan_async_joins": self.orphan_async_joins,
             "unjoined_async": self.unjoined_async,
             "unknown_trip_loops": self.unknown_trip_loops,
+            "worst_case_branches": self.worst_case_branches,
             "mxu_utilization": self.mxu_utilization,
             "achieved_tflops": self.achieved_flops / 1e12,
             "hbm_gbps": self.hbm_gbps,
@@ -324,6 +327,11 @@ class Engine:
                     worst = max(range(len(durs)), key=lambda i: durs[i])
                     result.merge_scaled(subs[worst], 1.0)
                     dur = durs[worst] + a.op_overhead_cycles
+                    if len(durs) > 1 and max(durs) > 1.5 * min(durs):
+                        # the worst-case assumption is materially wrong for
+                        # whichever arm actually runs — surface it, like
+                        # unknown_trip_loops does for loop bounds
+                        result.worst_case_branches += 1
                     self._emit(result, op, t, t + dur, Unit.SCALAR)
                     t += dur
                 result.op_count += 1
