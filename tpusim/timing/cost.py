@@ -72,6 +72,32 @@ _REGION_OPS = frozenset({
 _TRIP_COUNT_RE = re.compile(r'known_trip_count[^0-9]*?(\d+)')
 _INDUCTION_RE = re.compile(r'known_induction_variable')
 
+# Mosaic/Pallas custom-call cost estimates in backend_config:
+# {"custom_call_config": {"cost_estimate": {"flops": N,
+#  "transcendentals": N, "bytes_accessed": N}}}
+_CE_FLOPS_RE = re.compile(r'"flops"\s*:\s*"?([0-9.eE+]+)')
+_CE_TRANS_RE = re.compile(r'"transcendentals"\s*:\s*"?([0-9.eE+]+)')
+_CE_BYTES_RE = re.compile(r'"bytes_accessed"\s*:\s*"?([0-9.eE+]+)')
+
+
+def _parse_cost_estimate(
+    backend_config: str,
+) -> tuple[float, float, float] | None:
+    """(flops, transcendentals, bytes_accessed) from a Mosaic/Pallas
+    ``cost_estimate``, or None when absent."""
+    if "cost_estimate" not in backend_config:
+        return None
+    f = _CE_FLOPS_RE.search(backend_config)
+    t = _CE_TRANS_RE.search(backend_config)
+    b = _CE_BYTES_RE.search(backend_config)
+    if not (f or t or b):
+        return None
+    return (
+        float(f.group(1)) if f else 0.0,
+        float(t.group(1)) if t else 0.0,
+        float(b.group(1)) if b else 0.0,
+    )
+
 
 # ---------------------------------------------------------------------------
 # Structured attr helpers
@@ -309,6 +335,8 @@ class OpCost:
     vmem_bytes: float = 0.0
     ici_bytes: float = 0.0
     is_async: bool = False
+    #: bytes_accessed from a kernel's own cost estimate (-1 = none)
+    est_bytes: float = -1.0
 
     def add_compute(self, other: "OpCost") -> None:
         self.compute_cycles += other.compute_cycles
@@ -424,13 +452,30 @@ class CostModel:
         elif base == "custom-call":
             target = op.attrs.get("custom_call_target", "").strip('"')
             rate = self.custom_call_flops.get(target)
+            est = _parse_cost_estimate(op.attrs.get("backend_config", ""))
             if rate and rate > 0:
                 # caller recorded achieved FLOP/s for this kernel target
                 c.flops = float(out_elems)
                 c.compute_cycles = (
                     c.flops / rate * self.arch.clock_hz
                 )
-            c.unit = Unit.VPU
+                c.unit = Unit.VPU
+            elif est is not None:
+                # Mosaic/Pallas kernels publish their own cost estimate;
+                # price flops on the MXU (pallas matmul kernels are the
+                # common case) and transcendentals on the VPU
+                flops, trans, est_bytes = est
+                c.flops = flops
+                c.mxu_flops = flops
+                c.transcendentals = trans
+                c.compute_cycles = (
+                    flops / self.arch.mxu_flops_per_cycle
+                    + self._vpu_cycles(0, trans)
+                )
+                c.est_bytes = est_bytes
+                c.unit = Unit.MXU if flops > 0 else Unit.VPU
+            else:
+                c.unit = Unit.VPU
         elif base in ("infeed", "outfeed", "send", "recv"):
             c.unit = Unit.DMA
         else:
@@ -482,6 +527,10 @@ class CostModel:
         # SURVEY.md §7), split by memory space: vmem-resident buffers
         # stream at vmem bandwidth, everything else at achieved HBM rate
         c.hbm_bytes, c.vmem_bytes = _memory_bytes(comp, op, module)
+        if c.est_bytes >= 0:
+            # the kernel's own accounting (Mosaic cost_estimate) supersedes
+            # the operand/result approximation
+            c.hbm_bytes = c.est_bytes
         if base in _REGION_OPS:
             # slice-like ops touch only the moved region; XLA aliases the
             # untouched remainder in place (a full-buffer charge made a
