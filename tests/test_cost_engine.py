@@ -179,3 +179,99 @@ def test_stats_dict(tiny_mlp, v5p_cfg):
     assert d["sim_cycles"] == res.cycles
     assert d["collective_count"] == 1
     assert "busy_cycles_mxu" in d
+
+
+# -- conv dims: true convs vs XLA's matmul-as-dilated-conv lowering ---------
+
+def _conv_module(window: str, dim_labels: str | None,
+                 lhs: str = "bf16[4,1024,8,128]",
+                 rhs: str = "bf16[4,1024,8,128]",
+                 out: str = "bf16[4,8,1024,1024]") -> str:
+    dl = f", dim_labels={dim_labels}" if dim_labels else ""
+    return f"""
+HloModule convs, is_scheduled=true
+
+ENTRY %main (a: {lhs}, b: {rhs}) -> {out} {{
+  %a = {lhs} parameter(0)
+  %b = {rhs} parameter(1)
+  ROOT %conv = {out} convolution(%a, %b), window={{{window}}}{dl}
+}}
+"""
+
+
+def test_conv_dims_degenerate_batch_matmul():
+    """XLA:TPU lowers batched matmuls to convolution-base-dilated with
+    stride==size and lhs_dilate chosen so each output position hits exactly
+    one real tap per spatial dim (observed in the round-3 attention silicon
+    fixture, reports/silicon/attention_1chip).  K must be head_dim, not
+    head_dim x prod(window size) — the +3169% bug."""
+    from tpusim.timing.cost import conv_dims
+
+    mod = parse_hlo_module(_conv_module(
+        "size=4x8 stride=4x8 pad=3_3x7_7 lhs_dilate=3x7 rhs_reversal=1x1",
+        "0b1f_0o1i->01fb",
+    ))
+    op = mod.entry.op("conv")
+    b, m, n, k, dt = conv_dims(op, mod.entry)
+    assert k == 128                      # head_dim only: one real tap/dim
+    assert n == 1024
+    assert m == 4 * 8 * 1024
+    flops = 2.0 * b * m * n * k
+    assert flops == pytest.approx(2 * 32 * 1024 * 1024 * 128)  # true matmul
+
+
+def test_conv_dims_true_conv_same_padding():
+    """A plain 3x3 SAME conv charges ~9 taps in the interior, trimmed at
+    the edges (exact counting, not the full-kernel upper bound)."""
+    from tpusim.timing.cost import conv_dims
+
+    mod = parse_hlo_module(_conv_module(
+        "size=3x3 pad=1_1x1_1",
+        "b01f_01io->b01f",
+        lhs="bf16[16,56,56,64]", rhs="bf16[3,3,64,64]",
+        out="bf16[16,56,56,64]",
+    ))
+    op = mod.entry.op("conv")
+    b, m, n, k, dt = conv_dims(op, mod.entry)
+    # avg taps/dim = (2 + 3*54 + 2)/56; K = round(taps^2 * 64)
+    taps = (2 + 3 * 54 + 2) / 56
+    assert k == round(taps * taps * 64)
+    assert 0.9 * 9 * 64 < k < 9 * 64    # trimmed, but near the full kernel
+    assert n == 64
+    assert m == 16 * 56 * 56
+
+
+def test_conv_dims_missing_dim_labels_charges_full_kernel():
+    """Unparseable dim_labels must fall back to the full kernel extent
+    (the conservative pre-round-4 charge), not collapse the spatial
+    factor to 1."""
+    from tpusim.timing.cost import conv_dims
+
+    mod = parse_hlo_module(_conv_module(
+        "size=3x3 pad=1_1x1_1", None,
+        lhs="bf16[16,56,56,64]", rhs="bf16[3,3,64,64]",
+        out="bf16[16,56,56,64]",
+    ))
+    op = mod.entry.op("conv")
+    _, _, _, k, _ = conv_dims(op, mod.entry)
+    assert k == 9 * 64                   # in_feat fallback x prod(size)
+
+
+def test_avg_real_taps_trims_high_edge():
+    """pad=0_N windows run off the high edge; those taps must be trimmed
+    just like low-edge ones (fast path must not trigger)."""
+    from tpusim.timing.cost import _avg_real_taps
+
+    # in=10, k=2, stride=1, no pad_low: last output reads past the end
+    assert _avg_real_taps(10, 10, 2, 1, 0, 1, 1) == pytest.approx(1.9)
+    # fully interior: fast path, every tap real
+    assert _avg_real_taps(10, 9, 2, 1, 0, 1, 1) == 2.0
+
+
+def test_parse_window_negative_pad():
+    """XLA emits negative pads (conv gradients); they must parse, not be
+    silently dropped to 0."""
+    from tpusim.timing.cost import _parse_window
+
+    w = _parse_window("size=3 pad=-1_-1", 1)
+    assert w["pad"] == [(-1, -1)]

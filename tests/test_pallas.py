@@ -33,7 +33,7 @@ ENTRY %main (a: f32[1024,1024], b: f32[1024,1024]) -> f32[1024,1024] {
   ROOT %cc = f32[1024,1024]{1,0} custom-call(%a, %b), \
 custom_call_target="tpu_custom_call", \
 backend_config={"custom_call_config": {"cost_estimate": \
-{"flops": 2147483648, "transcendentals": 0, "bytes_accessed": 12582912}}}
+{"flops": 2147483648, "transcendentals": 0, "bytes_accessed": 4194304}}}
 }
 """
 
@@ -46,8 +46,9 @@ def test_mosaic_custom_call_priced_from_cost_estimate():
     assert res.mxu_flops == pytest.approx(2 ** 31)
     assert res.flops == pytest.approx(2 ** 31)
     # bytes_accessed supersedes the operand/result approximation (which
-    # would be 3 x 4MB = 12.58MB here they happen to agree; shrink it)
-    assert res.hbm_bytes == pytest.approx(12582912)
+    # would be 3 x 4MB = 12.58MB; the kernel reports only 4MB, so a
+    # matching result proves the estimate actually took precedence)
+    assert res.hbm_bytes == pytest.approx(4194304)
     # compute time ~ flops / MXU rate (compute-bound for this shape)
     a = cfg.arch
     expect = 2 ** 31 / a.mxu_flops_per_cycle
@@ -60,7 +61,7 @@ def test_mosaic_custom_call_without_estimate_falls_back():
     text = MOSAIC_HLO.replace(
         ', backend_config={"custom_call_config": {"cost_estimate": '
         '{"flops": 2147483648, "transcendentals": 0, '
-        '"bytes_accessed": 12582912}}}',
+        '"bytes_accessed": 4194304}}}',
         "",
     ).replace("\\\n", "")
     mod = parse_hlo_module(text)

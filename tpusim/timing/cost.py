@@ -131,7 +131,76 @@ def dot_dims(
     return b, m, n, k, lhs.dtype
 
 
-_WINDOW_SIZE_RE = re.compile(r"size=([0-9x]+)")
+_WINDOW_FIELD_RES = {
+    "size": re.compile(r"size=([0-9x]+)"),
+    "stride": re.compile(r"stride=([0-9x]+)"),
+    "pad": re.compile(r"pad=([0-9_x\-]+)"),
+    "lhs_dilate": re.compile(r"lhs_dilate=([0-9x]+)"),
+    "rhs_dilate": re.compile(r"rhs_dilate=([0-9x]+)"),
+}
+
+
+def _parse_window(window: str, ndims: int) -> dict[str, list]:
+    """Per-spatial-dim window fields with XLA defaults filled in."""
+    out: dict[str, list] = {}
+    for key, rx in _WINDOW_FIELD_RES.items():
+        m = rx.search(window)
+        if not m:
+            continue
+        if key == "pad":
+            pairs = []
+            for part in m.group(1).split("x"):
+                lo, _, hi = part.partition("_")
+                pairs.append((int(lo or 0), int(hi or 0)))
+            out[key] = pairs
+        else:
+            out[key] = [int(d) for d in m.group(1).split("x")]
+    n = len(out.get("size", [])) or ndims
+    out.setdefault("size", [1] * n)
+    out.setdefault("stride", [1] * n)
+    out.setdefault("pad", [(0, 0)] * n)
+    out.setdefault("lhs_dilate", [1] * n)
+    out.setdefault("rhs_dilate", [1] * n)
+    return out
+
+
+def _avg_real_taps(
+    in_size: int, out_size: int, k: int, stride: int,
+    pad_low: int, lhs_dil: int, rhs_dil: int,
+) -> float:
+    """Average number of kernel taps per output position that land on a
+    *real* input element — i.e. in bounds and not on a dilation hole.
+
+    XLA:TPU lowers batched matmuls to ``convolution-base-dilated`` with
+    stride/dilation chosen so each output position touches exactly one real
+    element per spatial dim (observed: ``size=4x8 stride=4x8 pad=3_3x7_7
+    lhs_dilate=3x7`` on a [4,...,8,...] batch grid).  Charging the full
+    ``prod(size)`` kernel there overstates FLOPs 32× (round-3 silicon,
+    attention +3169%).  Exact counting prices both true convs (where edge
+    padding trims a little) and these degenerate matmul encodings."""
+    if k <= 1 or in_size <= 0 or out_size <= 0:
+        return 1.0
+    if (
+        lhs_dil <= 1 and rhs_dil <= 1 and pad_low == 0
+        and (out_size - 1) * stride + k <= in_size
+    ):
+        return float(k)  # interior-only fast path: every tap is real
+    # sample output positions when the grid is large; tap pattern is
+    # periodic in stride/dilate so a prefix is representative
+    sample = range(out_size) if out_size <= 4096 else range(4096)
+    total = 0
+    for j in sample:
+        base = j * stride - pad_low
+        for p in range(k):
+            pos = base + p * rhs_dil
+            if pos < 0:
+                continue
+            if pos % lhs_dil:
+                continue
+            if pos // lhs_dil >= in_size:
+                continue
+            total += 1
+    return max(total / len(sample), 1e-6)
 
 
 def conv_dims(
@@ -139,34 +208,59 @@ def conv_dims(
 ) -> tuple[int, int, int, int, str]:
     """Convolution as an implicit matmul: (batch=1, M, N, K, dtype) with
     M = output spatial positions × batch, N = output features,
-    K = kernel spatial × input features / feature_groups."""
+    K = effective real kernel taps × input features / feature_groups.
+
+    "Effective real taps" counts only kernel positions that hit in-bounds,
+    non-dilation-hole input elements (see :func:`_avg_real_taps`) — this is
+    what makes XLA's matmul-as-dilated-conv lowering price like the matmul
+    it is."""
     rhs = _leaf_shape(comp, op.operands[1])
+    lhs = _leaf_shape(comp, op.operands[0])
     out = leaves_of(op.result)[0]
-    window = op.attrs.get("window", "")
-    m_sz = _WINDOW_SIZE_RE.search(window)
-    kernel_spatial = 1
-    if m_sz:
-        for d in m_sz.group(1).split("x"):
-            kernel_spatial *= int(d)
+    dim_labels = op.attrs.get("dim_labels", "")
     fgc = int(op.attrs.get("feature_group_count", "1") or 1)
     bgc = int(op.attrs.get("batch_group_count", "1") or 1)
-    dim_labels = op.attrs.get("dim_labels", "")
-    # rhs labels sit between '_' and '->': e.g. b01f_01io->b01f
+
     in_feat = out_feat = None
+    lhs_spatial: dict[int, int] = {}
+    out_spatial: dict[int, int] = {}
     if "_" in dim_labels and "->" in dim_labels:
-        rhs_labels = dim_labels.split("_")[1].split("->")[0]
+        lhs_labels, rest = dim_labels.split("_", 1)
+        rhs_labels, out_labels = rest.split("->", 1)
         for pos, ch in enumerate(rhs_labels):
             if ch == "i" and pos < len(rhs.shape):
                 in_feat = rhs.shape[pos]
             elif ch == "o" and pos < len(rhs.shape):
                 out_feat = rhs.shape[pos]
+        for pos, ch in enumerate(lhs_labels):
+            if ch.isdigit() and pos < len(lhs.shape):
+                lhs_spatial[int(ch)] = lhs.shape[pos]
+        for pos, ch in enumerate(out_labels):
+            if ch.isdigit() and pos < len(out.shape):
+                out_spatial[int(ch)] = out.shape[pos]
     if out_feat is None:
         out_feat = out.shape[-1] if out.shape else 1
     if in_feat is None:
         in_feat = rhs.shape[-2] if len(rhs.shape) >= 2 else 1
+
+    w = _parse_window(op.attrs.get("window", ""), len(lhs_spatial))
+    taps = 1.0
+    for d, k_sz in enumerate(w["size"]):
+        if d not in lhs_spatial or d not in out_spatial:
+            # unparseable dim_labels: charge the full kernel extent (the
+            # conservative pre-round-4 behavior) rather than collapsing
+            # the spatial factor to 1
+            taps *= max(float(k_sz), 1.0)
+            continue
+        taps *= _avg_real_taps(
+            lhs_spatial[d], out_spatial[d], k_sz,
+            w["stride"][d] if d < len(w["stride"]) else 1,
+            w["pad"][d][0] if d < len(w["pad"]) else 0,
+            w["lhs_dilate"][d] if d < len(w["lhs_dilate"]) else 1,
+            w["rhs_dilate"][d] if d < len(w["rhs_dilate"]) else 1,
+        )
     m = max(out.elems // max(out_feat, 1), 1)
-    k = max(kernel_spatial * in_feat // max(fgc * bgc, 1), 1)
-    lhs = _leaf_shape(comp, op.operands[0])
+    k = max(int(round(taps * in_feat)) // max(fgc * bgc, 1), 1)
     return 1, m, out_feat, k, lhs.dtype
 
 
@@ -419,19 +513,17 @@ class CostModel:
                 _leaf_shape(comp, o).elems for o in op.operands[:1]
             )
             if base == "reduce-window":
-                m_sz = _WINDOW_SIZE_RE.search(op.attrs.get("window", ""))
-                wnd = 1
-                if m_sz:
-                    for d in m_sz.group(1).split("x"):
-                        wnd *= int(d)
-                in_elems *= max(wnd, 1)
-            c.flops = float(in_elems)
-            # full cross-lane reductions run well below elementwise rate;
-            # windowed reductions are local and stream at elementwise rate
-            slowdown = (
-                1.0 if base == "reduce-window"
-                else self.arch.vpu_reduce_slowdown
-            )
+                # a windowed reduction streams in O(max(in, out)) work —
+                # hardware/XLA keep running extrema/sums; charging
+                # in_elems × window_elems priced a 1024-wide softmax max
+                # at ~17M fictitious cycles (round-3 silicon, VERDICT #3a)
+                c.flops = float(max(in_elems, out_elems))
+                slowdown = 1.0
+            else:
+                c.flops = float(in_elems)
+                # full cross-lane reductions run well below elementwise
+                # rate (fit against the reduction fixture)
+                slowdown = self.arch.vpu_reduce_slowdown
             c.compute_cycles = self._vpu_cycles(c.flops * slowdown, 0)
             c.unit = Unit.VPU
         elif base == "transpose":
