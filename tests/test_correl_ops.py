@@ -143,3 +143,81 @@ def test_correlate_workload_ops_end_to_end(tmp_path, cpu_mesh_runner):
         n_devices=1,
     )
     assert "CORREL_OPS_OK" in out
+
+
+# -- real-TPU xplane fixture (VERDICT r3 #2) --------------------------------
+# Captured live on a TPU v5 lite through the axon tunnel:
+# elementwise_stream (32Mi f32) wrapped in loopify(16), 3 executions under
+# jax.profiler.trace.  Real device planes name XLA Ops events with the FULL
+# instruction text ('%copy.8 = f32[...]{0:T(1024)} copy(...)'), carry no
+# hlo_op stat, and put whole-program durations on the 'XLA Modules' line.
+
+import pathlib
+
+XPLANE_FIXTURE = (
+    pathlib.Path(__file__).parent / "fixtures" / "xplane"
+    / "v5e_elementwise_loop16.xplane.pb"
+)
+
+
+def test_event_op_name_real_tpu_shapes():
+    from tpusim.harness.correl_ops import _event_op_name
+
+    assert _event_op_name(
+        "%copy.8 = f32[33554432]{0:T(1024)} copy(f32[33554432]{0:T(1024)} "
+        "%first.1)"
+    ) == "copy.8"
+    assert _event_op_name("%fusion.2") == "fusion.2"
+    assert _event_op_name("dot.1") == "dot.1"   # CPU planes: bare names
+
+
+def test_extract_op_profile_real_tpu_xplane():
+    pytest.importorskip("jax")
+    from tpusim.harness.correl_ops import extract_op_profile
+
+    ops = extract_op_profile(XPLANE_FIXTURE)
+    # keys must be bare instruction names, not full instruction text
+    assert "multiply_add_fusion.2" in ops, sorted(ops)[:10]
+    assert "while" in ops
+    fusion = ops["multiply_add_fusion.2"]
+    # 3 profiled executions x 16 loop iterations
+    assert fusion.count == 48.0
+    # ~408us per occurrence on the v5e (HBM-bound 256MB stream)
+    assert 2e5 < fusion.avg_ns < 8e5
+    # no host-python junk
+    assert not any(k.startswith("$") for k in ops)
+
+
+def test_extract_module_profile_real_tpu_xplane():
+    pytest.importorskip("jax")
+    from tpusim.harness.correl_ops import extract_module_profile
+
+    mods = extract_module_profile(XPLANE_FIXTURE)
+    assert len(mods) == 1
+    (mod,) = mods.values()
+    assert mod.count == 3.0               # three program executions
+    # whole program ~6.9ms: 16 x ~408us fusion + one-time carry copy
+    assert 5e6 < mod.avg_ns < 9e6
+
+
+def test_correlate_ops_matches_real_tpu_event_names():
+    """End-to-end name matching: engine per-op names vs real-TPU event
+    text must line up (the round-3 matcher matched ZERO ops)."""
+    pytest.importorskip("jax")
+    from tpusim.harness.correl_ops import extract_op_profile
+
+    silicon = extract_op_profile(XPLANE_FIXTURE)
+    res = _result({
+        "multiply_add_fusion.2": (1000.0, 16.0, "fusion"),
+        "copy.8": (500.0, 1.0, "copy"),
+        # the engine always records the loop container; silicon reports it
+        # too, spanning its whole body — it must not poison the denominator
+        "while": (16000.0, 1.0, "while"),
+    })
+    corr = correlate_ops(
+        res, silicon, clock_hz=1e9, workload="elem", real_iters=3,
+    )
+    names = {r.name for r in corr.rows}
+    assert "multiply_add_fusion.2" in names
+    assert "copy.8" in names
+    assert corr.matched_time_fraction > 0.9
