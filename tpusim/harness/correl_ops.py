@@ -269,19 +269,10 @@ def measure_device_time(
     import statistics
     import tempfile
 
-    import jax
-
     def _run(trace_dir: str | Path) -> dict[str, float]:
-        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-        out = None
-        for _ in range(max(warmup, 1)):
-            out = jitted(*args)
-        jax.block_until_ready(out)
-        with jax.profiler.trace(str(trace_dir)):
-            for _ in range(max(iters, 1)):
-                out = jitted(*args)
-            jax.block_until_ready(out)
-        mods = extract_module_events(latest_xplane(trace_dir))
+        mods = extract_module_events(
+            _trace_capture(fn, args, trace_dir, warmup=warmup, iters=iters)
+        )
         if not mods:
             raise RuntimeError(
                 "no device-plane XLA Modules events in profile; "
@@ -310,6 +301,30 @@ def latest_xplane(log_dir: str | Path) -> Path:
     return Path(paths[-1])
 
 
+def _trace_capture(
+    fn: Callable,
+    args: tuple,
+    log_dir: str | Path,
+    warmup: int = 2,
+    iters: int = 3,
+) -> Path:
+    """Warm up, then run ``fn`` ``iters`` times under
+    ``jax.profiler.trace``; returns the captured xplane path.  The single
+    timing-protocol home for both the per-op and per-module profiles."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = jitted(*args)
+    jax.block_until_ready(out)
+    with jax.profiler.trace(str(log_dir)):
+        for _ in range(max(iters, 1)):
+            out = jitted(*args)
+        jax.block_until_ready(out)
+    return latest_xplane(log_dir)
+
+
 def profile_workload(
     fn: Callable,
     args: tuple,
@@ -320,17 +335,9 @@ def profile_workload(
 ) -> dict[str, OpSilicon]:
     """Run ``fn`` under ``jax.profiler.trace`` and return per-op device
     durations (the nvprof-per-kernel pass of ``util/hw_stats``)."""
-    import jax
-
-    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    for _ in range(max(warmup, 1)):
-        out = jitted(*args)
-    jax.block_until_ready(out)
-    with jax.profiler.trace(str(log_dir)):
-        for _ in range(max(iters, 1)):
-            out = jitted(*args)
-        jax.block_until_ready(out)
-    return extract_op_profile(latest_xplane(log_dir))
+    return extract_op_profile(
+        _trace_capture(fn, args, log_dir, warmup=warmup, iters=iters)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -397,7 +404,8 @@ def correlate_ops(
             real_count=sil.count / max(real_iters, 1),
         ))
     corr.silicon_only = sorted(
-        k for k in sil_by_name if k not in sim_seen
+        k for k in sil_by_name
+        if k not in sim_seen and k not in control_names
     )
     corr.matched_time_fraction = (
         matched_real / total_real if total_real > 0 else 0.0

@@ -76,8 +76,13 @@ class ArchConfig:
     mxu_count: int = 8
     mxu_rows: int = 128
     mxu_cols: int = 128
-    # pipeline fill/drain latency (cycles) per matmul pass
+    # pipeline fill/drain latency (cycles), paid once per matmul op
     mxu_fill_cycles: int = 128
+    # minimum cycles per systolic pass: the next pass's weight tile loads
+    # while the current one streams (double-buffered), so a pass can't
+    # retire faster than the weight load — the floor small-m matmuls hit
+    # (fit against the lstm_layer silicon fixture, round 4)
+    mxu_weight_stall_cycles: int = 64
     # dtype multiplier: relative MAC throughput vs bf16
     dtype_mult: dict[str, float] = field(
         default_factory=lambda: {

@@ -457,15 +457,23 @@ class CostModel:
 
         The K dimension maps to the systolic rows, N to the columns, M rows
         stream through; tiles are distributed across the ``mxu_count``
-        arrays.  Fill/drain is paid once per pass — this is what makes small
-        matmuls MXU-inefficient, the analogue of the reference's tensor-core
+        arrays.  Weight tiles double-buffer: pass i+1's weights load while
+        pass i streams, so consecutive passes pipeline and the fill/drain
+        latency is paid once per op, not once per pass — charging it per
+        pass overstated small-m matmuls 2.4x (lstm_layer round-3 silicon,
+        +138%).  What survives per pass is the weight-load floor: a pass
+        cannot retire faster than its successor's tile loads
+        (``mxu_weight_stall_cycles``) — this is what makes small matmuls
+        MXU-inefficient, the analogue of the reference's tensor-core
         initiation intervals (``trace.config`` tensor 2,2)."""
         a = self.arch
         passes = b * math.ceil(k / a.mxu_rows) * math.ceil(n / a.mxu_cols)
         m_pad = max(8, math.ceil(m / 8) * 8)
-        per_pass = m_pad + a.mxu_fill_cycles
+        per_pass = max(m_pad, a.mxu_weight_stall_cycles)
         serial = math.ceil(passes / a.mxu_count)
-        return serial * per_pass / max(a.mxu_dtype_mult(dtype), 1e-6)
+        return (
+            serial * per_pass + a.mxu_fill_cycles
+        ) / max(a.mxu_dtype_mult(dtype), 1e-6)
 
     def _vpu_cycles(self, elem_ops: float, transcendentals: float) -> float:
         a = self.arch

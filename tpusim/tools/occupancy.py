@@ -100,7 +100,14 @@ def _occupancy_for(
     tile_fill = (k * n) / (k_tiles * rows * n_tiles * cols)
     m_pad = max(8, math.ceil(m / 8) * 8)
     row_fill = m / m_pad
-    pipeline_eff = m_pad / (m_pad + arch.mxu_fill_cycles)
+    # mirror CostModel.mxu_cycles: per-pass cost floors at the weight-load
+    # stall (double-buffered tiles), and fill/drain is paid once per op
+    passes = b * k_tiles * n_tiles
+    serial = max(math.ceil(passes / arch.mxu_count), 1)
+    per_pass = max(m_pad, arch.mxu_weight_stall_cycles)
+    pipeline_eff = (serial * m_pad) / (
+        serial * per_pass + arch.mxu_fill_cycles
+    )
     vmem_fraction = _op_bytes(comp, op) / max(arch.vmem_bytes, 1)
     return OpOccupancy(
         name=op.name, opcode=op.base, b=b, m=m, n=n, k=k, dtype=dtype,
