@@ -70,8 +70,11 @@ def run_matrix() -> dict[str, dict[str, float]]:
         tag = _overlay_tag(overlays)
         if tag:
             name += "__" + tag
+        # tuned=False: goldens are a MODEL regression gate; they must not
+        # shift when a live run refreshes configs/<arch>.tuned.flags
         report = simulate_trace(
-            FIXTURES / fixture, arch=arch, overlays=list(overlays)
+            FIXTURES / fixture, arch=arch, overlays=list(overlays),
+            tuned=False,
         )
         stats = {
             k: v for k, v in json.loads(report.stats.to_json()).items()

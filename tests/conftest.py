@@ -57,6 +57,16 @@ def cpu_mesh_runner():
     return run_in_cpu_mesh
 
 
+@pytest.fixture(autouse=True)
+def _isolate_tuned_overlays(monkeypatch, tmp_path):
+    """Pin TPUSIM_TUNED_DIR to an empty dir for every test: unit tests
+    assert model numbers against the PRESETS; a committed
+    ``configs/<arch>.tuned.flags`` (refreshed by any live bench run) must
+    not shift them.  Tests of the overlay mechanism itself re-set the env
+    var on top of this."""
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(tmp_path / "no_tuned"))
+
+
 # -- live-backend availability ----------------------------------------------
 #
 # Under axon the TPU device is reached through a tunnel; when the tunnel is

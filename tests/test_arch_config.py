@@ -82,3 +82,34 @@ def test_derived_quantities():
     assert a.seconds_to_cycles(1.0) == a.clock_hz
     assert a.mxu_dtype_mult("bf16") == 1.0
     assert a.mxu_dtype_mult("s8") == 2.0
+
+
+def test_tuned_overlay_applied_by_default(tmp_path, monkeypatch):
+    """A committed configs/<arch>.tuned.flags must apply automatically —
+    the tuner->tested-cfgs loop (VERDICT r3 #3: 'tune is never invoked in
+    any artifact-producing path')."""
+    from tpusim.timing.config import tuned_overlay_path
+
+    (tmp_path / "v5e.tuned.flags").write_text(
+        "# fit on silicon\n-arch.hbm_efficiency 0.91\n"
+    )
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(tmp_path))
+    assert tuned_overlay_path("v5e") == tmp_path / "v5e.tuned.flags"
+    cfg = load_config(arch="v5e")
+    assert cfg.arch.hbm_efficiency == 0.91
+    # explicit overlays still win over the tuned values
+    cfg2 = load_config(
+        arch="v5e", overlays=[{"arch": {"hbm_efficiency": 0.5}}]
+    )
+    assert cfg2.arch.hbm_efficiency == 0.5
+    # and the tuned layer can be disabled outright
+    cfg3 = load_config(arch="v5e", tuned=False)
+    assert cfg3.arch.hbm_efficiency != 0.91
+
+
+def test_tuned_overlay_absent_is_silent(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(tmp_path))  # empty dir
+    from tpusim.timing.config import tuned_overlay_path
+
+    assert tuned_overlay_path("v5e") is None
+    assert load_config(arch="v5e").arch.name == "v5e"

@@ -430,15 +430,15 @@ def correlate_workload_ops(
     import jax
 
     from tpusim.timing.arch import detect_arch
-    from tpusim.timing.config import SimConfig, load_config
+    from tpusim.timing.config import load_config
     from tpusim.timing.engine import Engine
     from tpusim.tracer.capture import capture
 
     cap = capture(fn, *args, name=name)
     if arch is None:
-        cfg = SimConfig(arch=detect_arch(jax.devices()[0].device_kind))
-    else:
-        cfg = load_config(arch=arch)
+        # named-preset route so the committed tuner overlay applies
+        arch = detect_arch(jax.devices()[0].device_kind).name
+    cfg = load_config(arch=arch)
     res = Engine(cfg).run(cap.module)
 
     log_dir = log_dir or tempfile.mkdtemp(prefix=f"tpusim_prof_{name}_")

@@ -128,7 +128,7 @@ def correlate_workload(
     import jax
 
     from tpusim.timing.arch import detect_arch
-    from tpusim.timing.config import SimConfig, load_config
+    from tpusim.timing.config import load_config
     from tpusim.timing.engine import Engine
     from tpusim.tracer.capture import capture, measure_wall_time
 
@@ -150,9 +150,9 @@ def correlate_workload(
             meta=cap.meta,
         )
     if arch is None:
-        cfg = SimConfig(arch=detect_arch(jax.devices()[0].device_kind))
-    else:
-        cfg = load_config(arch=arch)
+        # named-preset route so the committed tuner overlay applies
+        arch = detect_arch(jax.devices()[0].device_kind).name
+    cfg = load_config(arch=arch)
     res = Engine(cfg).run(cap.module)
 
     # ground truth = device time from the profiler's module timeline (the

@@ -341,22 +341,25 @@ def simulate_trace(
     config: SimConfig | None = None,
     arch: str | None = None,
     overlays: list[Any] | None = None,
+    tuned: bool = True,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
     The ``accel-sim.out -trace ... -config ...`` equivalent
-    (``main.cc:55-206``)."""
+    (``main.cc:55-206``).  ``tuned=False`` skips the committed tuner
+    overlay — golden regression sims pin it off so their stats don't
+    shift when a live run refreshes the fit."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
-    cfg = load_config(config, arch=arch, overlays=overlays)
     pod = load_trace(trace_path)
     if arch is None and config is None:
-        # default the arch to the one the trace was captured on
+        # default the arch to the one the trace was captured on, via the
+        # named-preset route so the committed tuner overlay applies
         kind = str(pod.meta.get("device_kind", ""))
         if kind:
             from tpusim.timing.arch import detect_arch
-            import dataclasses
 
-            cfg = dataclasses.replace(cfg, arch=detect_arch(kind))
+            arch = detect_arch(kind).name
+    cfg = load_config(config, arch=arch, overlays=overlays, tuned=tuned)
     return SimDriver(cfg).run(pod)

@@ -27,6 +27,7 @@ __all__ = [
     "load_config",
     "parse_flag_file",
     "overlay",
+    "tuned_overlay_path",
 ]
 
 
@@ -280,19 +281,46 @@ def parse_flag_file(path: str | Path) -> dict[str, Any]:
     return updates
 
 
+def tuned_overlay_path(arch_name: str) -> Path | None:
+    """Locate the committed tuner overlay for an arch, if one exists.
+
+    The tuner (``tpusim.harness.tuner``) writes silicon-fitted parameters
+    to ``configs/<arch>.tuned.flags`` — the analogue of the reference's
+    ``tested-cfgs`` produced by ``util/tuner/tuner.py:23-67`` and
+    re-validated every CI run.  ``$TPUSIM_TUNED_DIR``, when set, is the
+    EXCLUSIVE source (tests point it at an empty dir to isolate from repo
+    artifacts); otherwise the repo-root ``configs/`` directory is used."""
+    import os
+
+    env = os.environ.get("TPUSIM_TUNED_DIR")
+    base = (
+        Path(env) if env
+        else Path(__file__).resolve().parents[2] / "configs"
+    )
+    p = base / f"{arch_name.lower()}.tuned.flags"
+    return p if p.is_file() else None
+
+
 def load_config(
     base: "SimConfig | None" = None,
     *,
     arch: str | None = None,
     overlays: list[dict[str, Any] | str | Path] | None = None,
+    tuned: bool = True,
 ) -> SimConfig:
-    """Compose a SimConfig: named arch preset + overlay dicts / flag files /
-    JSON files, in order."""
+    """Compose a SimConfig: named arch preset + the committed tuner
+    overlay for that arch (when present and ``tuned=True``) + overlay
+    dicts / flag files / JSON files, in order.  Explicit overlays win
+    over the tuned values."""
     from tpusim.timing.arch import arch_preset
 
     cfg = base or SimConfig()
     if arch is not None:
         cfg = dataclasses.replace(cfg, arch=arch_preset(arch))
+        if tuned:
+            tp = tuned_overlay_path(arch)
+            if tp is not None:
+                cfg = overlay(cfg, parse_flag_file(tp))
     for item in overlays or []:
         if isinstance(item, (str, Path)):
             p = Path(item)
