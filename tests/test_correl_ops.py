@@ -221,3 +221,80 @@ def test_correlate_ops_matches_real_tpu_event_names():
     assert "multiply_add_fusion.2" in names
     assert "copy.8" in names
     assert corr.matched_time_fraction > 0.9
+
+
+def test_correlate_counters_from_real_xplane():
+    """Counter-level cross-check (VERDICT r3 #8): achieved HBM GB/s of the
+    heaviest streaming op derived from static bytes + measured device
+    time, vs the model's streaming rate."""
+    pytest.importorskip("jax")
+    from tpusim.harness.correl_ops import (
+        correlate_counters, extract_op_profile,
+    )
+    from tpusim.timing.config import load_config
+
+    silicon = extract_op_profile(XPLANE_FIXTURE)
+    res = _result({
+        "multiply_add_fusion.2": (6_500_000.0, 16.0, "fusion"),
+        "copy.8": (760_000.0, 1.0, "copy"),
+    })
+    # the fusion streams 32Mi f32 in + out per occurrence = 256MB
+    res.per_op_hbm_bytes["multiply_add_fusion.2"] = 16 * 2 * 32 * 2**20 * 4.0
+    res.per_op_flops["multiply_add_fusion.2"] = 16 * 32 * 2**20 * 1.0
+    # MXU counter keys on mxu_flops; tag the fusion as carrying a matmul
+    res.per_op_mxu_flops["multiply_add_fusion.2"] = 16 * 32 * 2**20 * 1.0
+
+    arch = load_config(arch="v5e", tuned=False).arch
+    counters = correlate_counters(
+        res, silicon, clock_hz=arch.clock_hz, arch=arch,
+    )
+    hbm = counters["hbm"]
+    assert hbm["op"] == "multiply_add_fusion.2"
+    # 256MB / ~408us measured = ~650 GB/s on the v5e — within the chip's
+    # physical envelope and of the same order as the modeled stream rate
+    assert 400.0 < hbm["real_gbps"] < 900.0
+    assert hbm["model_stream_gbps"] == pytest.approx(
+        arch.hbm_bandwidth * arch.hbm_efficiency / 1e9, rel=1e-3
+    )
+    assert 0.5 < hbm["real_vs_model"] < 1.6
+    assert counters["mxu"]["op"] == "multiply_add_fusion.2"
+
+
+def test_engine_fills_per_op_counters():
+    from pathlib import Path
+
+    from tpusim.timing.config import SimConfig
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.hlo_text import parse_hlo_module
+
+    fixtures = Path(__file__).parent / "fixtures"
+    mod = parse_hlo_module((fixtures / "tiny_mlp.hlo").read_text())
+    res = Engine(SimConfig()).run(mod)
+    assert res.per_op_flops.get("dot.1", 0) > 0
+    assert res.per_op_mxu_flops.get("dot.1", 0) > 0
+    assert res.per_op_hbm_bytes.get("dot.1", 0) > 0
+
+
+def test_correlate_counters_skips_non_mxu_and_zero_traffic():
+    """A VPU-only fusion (flops but no mxu_flops) must not masquerade as
+    the MXU check, and zero-traffic entries must not report 0 GB/s as if
+    it were a measurement."""
+    pytest.importorskip("jax")
+    from tpusim.harness.correl_ops import (
+        correlate_counters, extract_op_profile,
+    )
+    from tpusim.timing.config import load_config
+
+    silicon = extract_op_profile(XPLANE_FIXTURE)
+    res = _result({
+        "multiply_add_fusion.2": (6_500_000.0, 16.0, "fusion"),
+    })
+    res.per_op_flops["multiply_add_fusion.2"] = 1e9   # VPU flops only
+    res.per_op_hbm_bytes["multiply_add_fusion.2"] = 0.0
+
+    arch = load_config(arch="v5e", tuned=False).arch
+    counters = correlate_counters(
+        res, silicon, clock_hz=arch.clock_hz, arch=arch,
+    )
+    assert "mxu" not in counters    # no matmul op -> no MXU claim
+    assert "hbm" not in counters    # zero bytes -> no bandwidth claim

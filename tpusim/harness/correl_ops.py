@@ -33,6 +33,7 @@ __all__ = [
     "measure_device_time",
     "profile_workload",
     "correlate_ops",
+    "correlate_counters",
 ]
 
 #: control-flow ops whose engine duration aggregates their bodies — the
@@ -93,6 +94,9 @@ class OpCorrelation:
     silicon_only: list[str] = field(default_factory=list)
     #: fraction of measured device time covered by matched rows
     matched_time_fraction: float = 0.0
+    #: counter-level cross-check (achieved GB/s and TFLOP/s), see
+    #: :func:`correlate_counters`
+    counters: dict[str, Any] = field(default_factory=dict)
 
     @property
     def weighted_abs_error_pct(self) -> float:
@@ -146,6 +150,7 @@ class OpCorrelation:
             "by_opcode": self.by_opcode(),
             "sim_only": self.sim_only[:20],
             "silicon_only": self.silicon_only[:20],
+            **({"counters": self.counters} if self.counters else {}),
             "rows": [r.to_json() for r in self.rows],
         }
 
@@ -414,6 +419,81 @@ def correlate_ops(
     return corr
 
 
+def correlate_counters(
+    result: "Any",
+    silicon: dict[str, OpSilicon],
+    *,
+    clock_hz: float,
+    arch: "Any",
+) -> dict[str, Any]:
+    """Counter-level silicon cross-check (VERDICT r3 #8) — the
+    multi-counter rows of the reference's ``correl_mappings.py:21-100``,
+    TPU-shaped.
+
+    No DRAM/issue counters are exposed through this backend, so the
+    check derives the two that matter from static HLO analysis + measured
+    durations: for the heaviest streaming op, achieved HBM GB/s
+    (bytes/occurrence ÷ device time) vs the model's streaming rate; for
+    the heaviest matmul op, achieved TFLOP/s vs configured peak.  This
+    validates the bandwidth and compute-rate parameters independently of
+    end-to-end scheduling — a 2x-fast matmul model can't hide behind a
+    2x-slow DMA model here."""
+    sil = {_norm(k): v for k, v in silicon.items()}
+
+    def _sim_ns(name: str) -> float:
+        count = result.per_op_count.get(name, 1.0) or 1.0
+        return result.per_op_cycles.get(name, 0.0) / count / clock_hz * 1e9
+
+    def _heaviest(per_op: dict[str, float]):
+        best = None
+        for name, total in per_op.items():
+            count = result.per_op_count.get(name, 1.0) or 1.0
+            s = sil.get(_norm(name))
+            if s is None or s.avg_ns <= 0:
+                continue
+            per_occ = total / count
+            if per_occ <= 0:
+                continue  # zero-traffic entries would report 0 GB/s as data
+            if best is None or per_occ > best[1]:
+                best = (name, per_occ, s)
+        return best
+
+    out: dict[str, Any] = {}
+    hbm = _heaviest(result.per_op_hbm_bytes)
+    if hbm is not None:
+        name, bytes_occ, s = hbm
+        model_gbps = arch.hbm_bandwidth * arch.hbm_efficiency / 1e9
+        real_gbps = bytes_occ / s.avg_ns          # B/ns == GB/s
+        out["hbm"] = {
+            "op": _norm(name),
+            "bytes_per_occurrence": round(bytes_occ, 1),
+            "real_gbps": round(real_gbps, 1),
+            "sim_gbps": round(bytes_occ / max(_sim_ns(name), 1e-9), 1),
+            "model_stream_gbps": round(model_gbps, 1),
+            "real_vs_model": round(real_gbps / max(model_gbps, 1e-9), 3),
+        }
+    # MXU check keys on mxu_flops specifically: the heaviest *matmul* op,
+    # not whichever fusion has the most total (VPU-included) flops
+    mxu = _heaviest(result.per_op_mxu_flops)
+    if mxu is not None:
+        name, flops_occ, s = mxu
+        peak_tflops = arch.peak_bf16_flops / 1e12
+        real_tflops = flops_occ / s.avg_ns / 1e3  # flops/ns ÷ 1e3 == TF/s
+        out["mxu"] = {
+            "op": _norm(name),
+            "flops_per_occurrence": round(flops_occ, 1),
+            "real_tflops": round(real_tflops, 2),
+            "sim_tflops": round(
+                flops_occ / max(_sim_ns(name), 1e-9) / 1e3, 2
+            ),
+            "peak_tflops": round(peak_tflops, 1),
+            "real_utilization": round(
+                real_tflops / max(peak_tflops, 1e-9), 3
+            ),
+        }
+    return out
+
+
 def correlate_workload_ops(
     fn: Callable,
     args: tuple,
@@ -443,10 +523,14 @@ def correlate_workload_ops(
 
     log_dir = log_dir or tempfile.mkdtemp(prefix=f"tpusim_prof_{name}_")
     silicon = profile_workload(fn, args, log_dir=log_dir, iters=iters)
-    return correlate_ops(
+    corr = correlate_ops(
         res, silicon, clock_hz=cfg.arch.clock_hz, workload=name,
         real_iters=iters,
     )
+    corr.counters = correlate_counters(
+        res, silicon, clock_hz=cfg.arch.clock_hz, arch=cfg.arch,
+    )
+    return corr
 
 
 def write_correl_ops(

@@ -91,6 +91,17 @@ class EngineResult:
         default_factory=lambda: defaultdict(float)
     )
     per_op_opcode: dict[str, str] = field(default_factory=dict)
+    # per-instruction traffic/work (the counter substrate for the
+    # counter-level silicon cross-check: achieved GB/s and TFLOP/s per op)
+    per_op_hbm_bytes: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    per_op_flops: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
+    per_op_mxu_flops: dict[str, float] = field(
+        default_factory=lambda: defaultdict(float)
+    )
     timeline: list[TimelineEvent] = field(default_factory=list)
 
     # -- derived -----------------------------------------------------------
@@ -139,6 +150,12 @@ class EngineResult:
             self.per_op_cycles[k] += v * times
         for k, v in other.per_op_count.items():
             self.per_op_count[k] += v * times
+        for k, v in other.per_op_hbm_bytes.items():
+            self.per_op_hbm_bytes[k] += v * times
+        for k, v in other.per_op_flops.items():
+            self.per_op_flops[k] += v * times
+        for k, v in other.per_op_mxu_flops.items():
+            self.per_op_mxu_flops[k] += v * times
         self.per_op_opcode.update(other.per_op_opcode)
 
     def stats_dict(self) -> dict[str, float]:
@@ -426,6 +443,7 @@ class Engine:
                 result.unit_busy_cycles[Unit.DMA.value] += dur
                 result.opcode_cycles[base] += dur
                 result.hbm_bytes += cost.hbm_bytes
+                result.per_op_hbm_bytes[op.name] += cost.hbm_bytes
                 self._emit(result, op, start, start + dur, Unit.DMA)
                 t += a.op_overhead_cycles
                 result.op_count += 1
@@ -469,6 +487,12 @@ class Engine:
             result.transcendentals += cost.transcendentals
             result.hbm_bytes += cost.hbm_bytes
             result.vmem_bytes += cost.vmem_bytes
+            if cost.hbm_bytes > 0:
+                result.per_op_hbm_bytes[op.name] += cost.hbm_bytes
+            if cost.flops > 0:
+                result.per_op_flops[op.name] += cost.flops
+            if cost.mxu_flops > 0:
+                result.per_op_mxu_flops[op.name] += cost.mxu_flops
             if dur > 0:
                 result.unit_busy_cycles[cost.unit.value] += dur
                 result.opcode_cycles[base] += dur
