@@ -286,7 +286,8 @@ def write_overlay(result: TunerResult, path: str | Path) -> None:
 
 
 def tune_power(
-    arch_name: str, out_dir: str | Path | None = None
+    arch_name: str, out_dir: str | Path | None = None,
+    probe: dict | None = None,
 ) -> "Path":
     """Fit power coefficients for one generation and persist them — the
     AccelWattch hw-profiler + quadprog pipeline (``AccelWattch.md:110-125``).
@@ -298,24 +299,39 @@ def tune_power(
     coefficients always have a stated provenance."""
     from tpusim.power.telemetry import (
         FITTED_DIR,
+        PowerSample,
         anchor_samples,
         fit_power_coefficients,
-        read_power_watts,
+        probe_power_sources,
         save_fitted,
     )
 
-    source = "telemetry" if read_power_watts() is not None else "anchors"
-    # telemetry-driven sampling would attach measured rates per workload;
-    # with no source the anchors carry both rates and watts
+    # callers that already probed pass their result in so the logged and
+    # committed provenance can't disagree across two reads
+    if probe is None:
+        probe = probe_power_sources()
+    watts = probe.get("watts")
+    source = "telemetry" if watts is not None else "anchors"
     samples = anchor_samples(arch_name)
+    meta: dict = {
+        "source": source,
+        # the committed evidence: every source tried and what it said
+        "telemetry_probe": probe["tried"],
+    }
+    if watts is not None:
+        # one real measured point (chip at rest when tune_power runs)
+        # replaces the guessed idle anchor; workload-resolved samples
+        # need sample_workload_power on a telemetry-capable VM
+        samples = [PowerSample("measured_idle", float(watts))] + [
+            s for s in samples if s.name != "idle"
+        ]
+        meta["measured_idle_watts"] = float(watts)
+    else:
+        meta["note"] = (
+            "no power source exposed on this VM (see telemetry_probe); "
+            "anchor fixtures are published TDP-class estimates — re-run "
+            "tune_power on a telemetry-capable TPU-VM"
+        )
+    meta["samples"] = [s.name for s in samples]
     coeffs = fit_power_coefficients(samples, arch_name)
-    return save_fitted(
-        coeffs, out_dir or FITTED_DIR,
-        meta={
-            "source": source,
-            "samples": [s.name for s in samples],
-            "note": "anchor fixtures are published TDP-class estimates; "
-                    "re-run tune_power on a telemetry-capable TPU-VM to "
-                    "replace them with measured points",
-        },
-    )
+    return save_fitted(coeffs, out_dir or FITTED_DIR, meta=meta)
