@@ -159,3 +159,28 @@ def test_large_module_memory_bound():
 
     assert lazy_peak < 4 * size, (lazy_peak, size)
     assert eager_peak > lazy_peak * 2, (eager_peak, lazy_peak)
+
+
+def test_lazy_vmem_scan_matches_eager_on_real_silicon_trace():
+    """Contract on a REAL captured module (reduction fixture): the lazy
+    raw-text scan and the eager IR walk must agree on vmem residency,
+    including the alias rules (copy-start tuples, while results, in-place
+    body DUS) that round 4 added after a 5x overcount."""
+    from pathlib import Path
+
+    from tpusim.timing.engine import _vmem_resident_bytes
+    from tpusim.trace.format import load_trace
+
+    fdir = (
+        Path(__file__).parent.parent / "reports" / "silicon" / "reduction"
+    )
+    if not fdir.exists():
+        pytest.skip("silicon fixtures not present")
+    td = load_trace(fdir)
+    mod = next(iter(td.modules.values()))
+    eager = _vmem_resident_bytes(mod)
+    text = (fdir / "modules" / "reduction.hlo").read_text()
+    lazy = parse_hlo_module_lazy(text)
+    assert lazy.vmem_resident_bytes() == pytest.approx(eager, rel=0.02)
+    # one 67MB carry + its double buffer — NOT five aliases of it
+    assert eager < 3 * 67.2e6

@@ -306,26 +306,40 @@ def tune_power(
         save_fitted,
     )
 
-    # callers that already probed pass their result in so the logged and
-    # committed provenance can't disagree across two reads
+    # Callers that already probed (and platform-verified) pass their
+    # result in, vouching for it — that keeps logged and committed
+    # provenance from disagreeing across two reads AND means a bare
+    # `tune_power()` on a workstation whose hwmon exposes a battery rail
+    # cannot relabel committed TPU coefficients as source=telemetry.
+    trusted = probe is not None
     if probe is None:
         probe = probe_power_sources()
     watts = probe.get("watts")
-    source = "telemetry" if watts is not None else "anchors"
+    use_measurement = trusted and watts is not None
     samples = anchor_samples(arch_name)
     meta: dict = {
-        "source": source,
+        "source": "telemetry" if use_measurement else "anchors",
         # the committed evidence: every source tried and what it said
         "telemetry_probe": probe["tried"],
     }
-    if watts is not None:
-        # one real measured point (chip at rest when tune_power runs)
-        # replaces the guessed idle anchor; workload-resolved samples
-        # need sample_workload_power on a telemetry-capable VM
-        samples = [PowerSample("measured_idle", float(watts))] + [
+    if use_measurement:
+        # one real measured point (chips at rest when tune_power runs),
+        # normalized per chip (anchors are per-chip operating points; an
+        # 8-chip VM's summed idle watts is not one chip's idle), replaces
+        # the guessed idle anchor
+        chips = max(int(probe.get("chips") or 1), 1)
+        per_chip = float(watts) / chips
+        samples = [PowerSample("measured_idle", per_chip)] + [
             s for s in samples if s.name != "idle"
         ]
-        meta["measured_idle_watts"] = float(watts)
+        meta["measured_idle_watts"] = per_chip
+        meta["measured_chips"] = chips
+    elif watts is not None:
+        meta["note"] = (
+            "a power reading exists but was self-probed without platform "
+            "verification — pass probe= from a TPU-guarded caller (bench) "
+            "to use it; keeping anchor fixtures"
+        )
     else:
         meta["note"] = (
             "no power source exposed on this VM (see telemetry_probe); "

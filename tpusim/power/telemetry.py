@@ -96,6 +96,7 @@ def probe_power_sources() -> dict:
 
     tried: list[dict] = []
     watts: float | None = None
+    n_chips: int | None = None
 
     try:
         from tpu_info import metrics  # type: ignore
@@ -107,8 +108,9 @@ def probe_power_sources() -> dict:
         vals = [v for v in vals if v]
         if vals:
             watts = float(sum(vals))
+            n_chips = len(vals)
             tried.append({"source": "tpu_info", "ok": True,
-                          "watts": watts})
+                          "watts": watts, "chips": n_chips})
         else:
             tried.append({"source": "tpu_info", "ok": False,
                           "detail": f"{len(chips)} chips, "
@@ -143,7 +145,7 @@ def probe_power_sources() -> dict:
         tried.append({"source": "hwmon", "ok": False,
                       "detail": "no /sys/class/hwmon power rails"})
 
-    return {"watts": watts, "tried": tried}
+    return {"watts": watts, "chips": n_chips, "tried": tried}
 
 
 def sample_workload_power(

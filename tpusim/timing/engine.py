@@ -191,11 +191,23 @@ class EngineResult:
 
 def _vmem_resident_bytes(module: ModuleTrace) -> float:
     """Total bytes XLA pinned in vmem (layout memory space ``S(1)``),
-    counted once per defining op.  Pass-through ops (tuple/gte/bitcast/
-    parameter) alias existing buffers and are skipped — except entry
-    parameters, which are real allocations.  This is the module's vmem
-    residency demand; the capacity check compares it to the 128MB budget
-    the way the reference checks shmem/L1 occupancy (gpu-cache.h)."""
+    counted once per *allocating* op.  This is the module's vmem residency
+    demand; the capacity check compares it to the 128MB budget the way the
+    reference checks shmem/L1 occupancy (gpu-cache.h).
+
+    Alias chains must not be double-counted (round-4 fix: the reduction
+    fixture's one 67MB carry was counted 5x — copy-start, copy-done,
+    while, and the in-place body DUS all carry the same S(1) layout —
+    which tripped a phantom 2.6GB spill and tripled the simulated time):
+
+    * pass-through ops (tuple/gte/bitcast/parameter) alias — skipped,
+      except entry parameters, which are real allocations;
+    * ``while``/``conditional`` results alias their init/branch values;
+    * ``*-done`` ops alias the buffers their ``*-start`` allocated;
+    * ``copy-start`` result is (dst, src-alias, ctx) — only its largest
+      leaf (the destination) is a new allocation;
+    * non-entry ``dynamic-update-slice`` is the in-place carry update of
+      a scan body (XLA aliases it onto the parameter)."""
     total = 0.0
     entry_name = module.entry_name
     for cname, comp in module.computations.items():
@@ -204,9 +216,28 @@ def _vmem_resident_bytes(module: ModuleTrace) -> float:
             if op.opcode in FREE_OPCODES or op.base in FREE_OPCODES:
                 if not (is_entry and op.opcode == "parameter"):
                     continue
-            for leaf in leaves_of(op.result):
-                if leaf.memory_space != 0:
-                    total += leaf.nbytes
+            if op.base in ("while", "conditional") or op.is_async_done:
+                continue
+            if not is_entry and op.base == "dynamic-update-slice":
+                continue
+            leaves = leaves_of(op.result)
+            if op.is_async_start and op.base == "copy":
+                # result is (dst, src-alias, ctx): only the leading dst
+                # leaf is a new allocation (a vmem->HBM spill copy's S(1)
+                # src alias must not re-count the source buffer)
+                if leaves and leaves[0].memory_space != 0:
+                    total += leaves[0].nbytes
+            elif op.is_async_start:
+                # collective starts carry (operand-alias, result, ...):
+                # one buffer, not the alias pair
+                total += max(
+                    (l.nbytes for l in leaves if l.memory_space != 0),
+                    default=0.0,
+                )
+            else:
+                total += sum(
+                    l.nbytes for l in leaves if l.memory_space != 0
+                )
     return total
 
 

@@ -46,7 +46,9 @@ _VMEM_DEF_RE = re.compile(
 _VMEM_SHAPE_RE = re.compile(
     r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[^\]]*)\]\{[^}]*S\([1-9]\d*\)[^}]*\}"
 )
-_OPCODE_AFTER_SHAPE_RE = re.compile(r"\}\s*([a-z][\w\-]*)\(")
+#: opcode following the result: `...} opcode(` for array results,
+#: `...}) opcode(` for tuple results
+_OPCODE_AFTER_SHAPE_RE = re.compile(r"[})]\s*([a-z][\w\-]*)\(")
 
 _DTYPE_BYTES = {
     "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3": 1,
@@ -151,22 +153,45 @@ class LazyModuleTrace(ModuleTrace):
 
     def vmem_resident_bytes(self) -> float:
         """Raw-text equivalent of the engine's S(1) residency walk: sum
-        result-layout vmem bytes over defining lines, skipping aliasing
-        opcodes, without parsing any computation."""
+        result-layout vmem bytes over *allocating* lines, without parsing
+        any computation.  Mirrors ``_vmem_resident_bytes``'s alias rules
+        (while/conditional/*-done results, non-entry dynamic-update-slice,
+        and all but the destination leaf of copy-start alias existing
+        buffers — see the engine docstring for the 5x-overcount this
+        prevents).  Only the RESULT side of each line is scanned: operand
+        references in optimized HLO text carry layouts too, and counting
+        an S(1) operand mention would re-count its defining op's buffer."""
+        entry_span = (
+            self._spans.get(self.entry_name)
+            if self.entry_name is not None else None
+        )
         total = 0.0
-        for line in self._text.splitlines():
+        offset = 0  # running char offset: O(text) overall, no str.find
+        for line in self._text.splitlines(keepends=True):
+            idx = offset
+            offset += len(line)
             dm = _VMEM_DEF_RE.search(line)
             if not dm:
                 continue
             op_m = _OPCODE_AFTER_SHAPE_RE.search(line)
             opcode = op_m.group(1) if op_m else ""
+            in_entry = (
+                entry_span is not None
+                and entry_span[0] <= idx < entry_span[1]
+            )
             if opcode in FREE_OPCODES:
-                # entry parameters are real allocations; the lazy scan
-                # cannot cheaply tell entry from nested, so parameters in
-                # the ENTRY span are counted via the span check below
-                if opcode != "parameter" or not self._in_entry_span(line):
+                # entry parameters are real allocations; nested ones alias
+                if opcode != "parameter" or not in_entry:
                     continue
-            for sm in _VMEM_SHAPE_RE.finditer(line):
+            if opcode in ("while", "conditional") or opcode.endswith("-done"):
+                continue
+            if opcode == "dynamic-update-slice" and not in_entry:
+                continue
+            # the opcode regex anchors on the result's closing brace —
+            # keep it in the slice so the shape regex still matches
+            result_side = line[:op_m.start() + 1] if op_m else line
+            leaf_bytes = []
+            for sm in _VMEM_SHAPE_RE.finditer(result_side):
                 elems = 1
                 dims = sm.group("dims").strip()
                 if dims:
@@ -176,17 +201,19 @@ class LazyModuleTrace(ModuleTrace):
                         except ValueError:
                             elems = 0
                             break
-                total += elems * _DTYPE_BYTES.get(sm.group("dtype"), 4)
+                leaf_bytes.append(
+                    elems * _DTYPE_BYTES.get(sm.group("dtype"), 4)
+                )
+            if opcode == "copy-start":
+                # result is (dst, src-alias, ctx): dst leads
+                total += leaf_bytes[0] if leaf_bytes else 0.0
+            elif opcode.endswith("-start"):
+                # collective starts carry (operand-alias, result, ...):
+                # count one buffer, not the alias pair
+                total += max(leaf_bytes, default=0.0)
+            else:
+                total += sum(leaf_bytes)
         return total
-
-    def _in_entry_span(self, line: str) -> bool:
-        if self.entry_name is None:
-            return False
-        span = self._spans.get(self.entry_name)
-        if span is None:
-            return False
-        idx = self._text.find(line)
-        return span[0] <= idx < span[1] if idx >= 0 else False
 
 
 def parse_hlo_module_lazy(
