@@ -275,3 +275,29 @@ def test_parse_window_negative_pad():
 
     w = _parse_window("size=3 pad=-1_-1", 1)
     assert w["pad"] == [(-1, -1)]
+
+
+def test_gather_charges_per_row_descriptor_overhead(v5p_cfg):
+    """A scattered gather pays a per-row DMA descriptor cost the streaming
+    roofline can't see (embedding fixture read -50% without it)."""
+    from tpusim.timing.cost import CostModel
+
+    text = """
+HloModule g, is_scheduled=true
+
+ENTRY %main (t: bf16[131072,1024], ids: s32[8192]) -> bf16[8192,1024] {
+  %t = bf16[131072,1024]{1,0} parameter(0)
+  %ids = s32[8192]{0} parameter(1)
+  ROOT %g = bf16[8192,1024]{1,0} gather(%t, %ids), offset_dims={1}, collapsed_slice_dims={0}, start_index_map={0}, index_vector_dim=1, slice_sizes={1,1024}
+}
+"""
+    mod = parse_hlo_module(text)
+    comp = mod.entry
+    cm = CostModel(v5p_cfg.arch)
+    c = cm._compute_cost(comp.op("g"), comp, mod)
+    assert c.compute_cycles == pytest.approx(
+        8192 * v5p_cfg.arch.gather_row_overhead_cycles
+    )
+    # region scoping still caps the memory side at the moved rows
+    full = cm.op_cost(comp.op("g"), comp, mod)
+    assert full.hbm_bytes <= 2 * 2 * 8192 * 1024 + 8192 * 4

@@ -540,6 +540,19 @@ class CostModel:
             c.compute_cycles = out_elems / self.arch.vpu_flops_per_cycle
         elif base in DATA_MOVEMENT_OPS:
             c.unit = Unit.DMA
+            if base in ("gather", "scatter"):
+                # scattered rows pay a per-descriptor cost the streaming
+                # roofline can't see; recorded as compute so the charge
+                # survives fusion aggregation (the gather usually lives
+                # inside a fusion whose memory term is operand-level)
+                slice_elems = 1
+                for d in _int_set(op.attrs, "slice_sizes"):
+                    slice_elems *= max(d, 1)
+                if slice_elems > 0 and out_elems > 0:
+                    rows = max(out_elems // slice_elems, 1)
+                    c.compute_cycles = (
+                        rows * float(self.arch.gather_row_overhead_cycles)
+                    )
         elif base == "sort":
             n_el = float(max(out_elems, 2))
             c.flops = n_el * math.log2(n_el) * 4.0
