@@ -278,6 +278,17 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     arch = manifest.get("arch", "v5e")
     engine = Engine(load_config(arch=arch))
 
+    try:
+        from tpusim.harness.correl_ops import (
+            load_known_outliers, match_known_outlier,
+        )
+
+        known_outliers = load_known_outliers()
+    except Exception as e:
+        log(f"bench(fixture): known-outlier load FAILED: "
+            f"{type(e).__name__}: {e}")
+        known_outliers, match_known_outlier = [], None
+
     detail = {}
     errs = []
     for entry in manifest.get("workloads", []):
@@ -311,6 +322,12 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
                 "err_pct": round(err, 2),
                 "real_source": src,
             }
+            if known_outliers and match_known_outlier is not None:
+                reason = match_known_outlier(
+                    known_outliers, name, abs_error_pct=abs(err),
+                )
+                if reason is not None:
+                    detail[name]["known_outlier"] = reason
             log(f"bench(fixture): {name:24s} sim={sim_s * 1e6:9.1f}us "
                 f"real={real_s * 1e6:9.1f}us err={err:+7.2f}%"
                 + ("  [wall-sourced truth]" if src != "device" else ""))

@@ -298,3 +298,80 @@ def test_correlate_counters_skips_non_mxu_and_zero_traffic():
     )
     assert "mxu" not in counters    # no matmul op -> no MXU claim
     assert "hbm" not in counters    # zero bytes -> no bandwidth claim
+
+
+def test_known_outliers_annotate_but_never_excuse_regressions(tmp_path):
+    """The curated outlier list (known.correlation.outliers.list slot):
+    matches are annotated, the honest mean is unchanged, and an entry's
+    bound stops covering a deviation that regresses past it."""
+    from tpusim.harness.correl_ops import (
+        match_known_outlier, write_correl_ops,
+    )
+
+    outliers = [{
+        "workload": "w_known", "reason": "understood: wall-sourced gap",
+        "max_abs_error_pct": 30,
+    }]
+    assert match_known_outlier(outliers, "w_known", abs_error_pct=25.0)
+    assert match_known_outlier(outliers, "w_known", abs_error_pct=300.0) \
+        is None                        # regressed past its bound
+    assert match_known_outlier(outliers, "other", abs_error_pct=25.0) is None
+
+    known = OpCorrelation("w_known", rows=[
+        OpRow("a", "fusion", 125.0, 100.0, 1, 1),   # +25%
+    ])
+    fresh = OpCorrelation("w_new", rows=[
+        OpRow("b", "dot", 110.0, 100.0, 1, 1),      # +10%
+    ])
+    p = write_correl_ops(
+        [known, fresh], tmp_path / "c.json", known_outliers=outliers,
+    )
+    doc = json.loads(p.read_text())
+    assert doc["mean_weighted_abs_error_pct"] == pytest.approx(17.5)
+    assert doc["mean_excl_known_outliers_pct"] == pytest.approx(10.0)
+    by_wl = {w["workload"]: w for w in doc["workloads"]}
+    assert "known_outlier" in by_wl["w_known"]
+    assert "known_outlier" not in by_wl["w_new"]
+
+
+def test_load_known_outliers_reads_committed_config():
+    from tpusim.harness.correl_ops import load_known_outliers
+
+    outliers = load_known_outliers()
+    assert isinstance(outliers, list)
+    # the committed config carries the wall-sourced-truth deviation
+    assert any(
+        o.get("workload") == "elementwise_stream" for o in outliers
+    )
+
+
+def test_known_outlier_edge_cases(tmp_path):
+    """Malformed configs degrade to no-outliers; non-finite regressions
+    are never excused; a missing workload key never wildcards."""
+    import math as _math
+
+    from tpusim.harness.correl_ops import (
+        load_known_outliers, match_known_outlier,
+    )
+
+    # wrong-shaped but valid JSON -> []
+    p = tmp_path / "bad1.json"
+    p.write_text('[{"workload": "x"}]')
+    assert load_known_outliers(p) == []
+    p.write_text('{"outliers": {"workload": "x"}}')
+    assert load_known_outliers(p) == []
+    p.write_text('{"outliers": ["just-a-string", {"workload": "x"}]}')
+    assert load_known_outliers(p) == [{"workload": "x"}]
+
+    bounded = [{"workload": "w", "reason": "r", "max_abs_error_pct": 30}]
+    # inf/NaN/unmeasured regressions are the worst case, not covered
+    assert match_known_outlier(bounded, "w", abs_error_pct=_math.inf) is None
+    assert match_known_outlier(bounded, "w", abs_error_pct=None) is None
+    # a typo'd/missing workload key must not match everything
+    assert match_known_outlier(
+        [{"worklaod": "w", "reason": "r"}], "anything", abs_error_pct=1.0,
+    ) is None
+    # explicit wildcard still works
+    assert match_known_outlier(
+        [{"workload": "*", "reason": "r"}], "anything", abs_error_pct=1.0,
+    ) == "r"

@@ -533,22 +533,98 @@ def correlate_workload_ops(
     return corr
 
 
+def load_known_outliers(path: str | Path | None = None) -> list[dict]:
+    """Curated understood-deviation list — the
+    ``util/plotting/known.correlation.outliers.list`` analogue.  Entries
+    name a workload (optionally an op), the REASON the deviation is
+    understood, and the error bound the explanation covers; reports
+    annotate matches so new regressions aren't drowned by known ones.
+    Default location: repo-root ``configs/known_outliers.json``."""
+    if path is None:
+        path = (
+            Path(__file__).resolve().parents[2]
+            / "configs" / "known_outliers.json"
+        )
+    path = Path(path)
+    if not path.is_file():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except (ValueError, OSError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    outliers = doc.get("outliers", [])
+    if not isinstance(outliers, list):
+        return []
+    return [o for o in outliers if isinstance(o, dict)]
+
+
+def match_known_outlier(
+    outliers: list[dict], workload: str,
+    op: str | None = None, abs_error_pct: float | None = None,
+) -> str | None:
+    """The reason string of the first matching entry, or None.  An entry
+    with ``max_abs_error_pct`` only covers deviations within that bound —
+    a known +30% outlier that regresses to +300% (or to a non-finite
+    error) must NOT stay excused.  ``workload`` is required; only the
+    explicit ``"*"`` wildcards."""
+    for o in outliers:
+        if o.get("workload") not in (workload, "*"):
+            continue
+        if o.get("op") and o.get("op") != op:
+            continue
+        bound = o.get("max_abs_error_pct")
+        if bound is not None:
+            # a bounded excuse needs a finite, in-bound error to apply;
+            # an unmeasurable/inf regression is the worst case, not a
+            # covered one
+            if abs_error_pct is None or not math.isfinite(abs_error_pct):
+                continue
+            if abs_error_pct > bound:
+                continue
+        return str(o.get("reason", "known outlier"))
+    return None
+
+
 def write_correl_ops(
-    correlations: list[OpCorrelation], path: str | Path
+    correlations: list[OpCorrelation], path: str | Path,
+    known_outliers: list[dict] | None = None,
 ) -> Path:
     """Write the ``correl_ops.json`` artifact (one entry per workload,
-    plus the cross-workload worst-op summary)."""
+    plus the cross-workload worst-op summary).  Known-outlier matches are
+    ANNOTATED, never removed: the headline mean stays honest, and a
+    separate mean excluding understood deviations shows what's left."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    if known_outliers is None:
+        known_outliers = load_known_outliers()
     finite = [
         c.weighted_abs_error_pct for c in correlations
         if math.isfinite(c.weighted_abs_error_pct)
     ]
+    entries = []
+    unexplained = []
+    for c in correlations:
+        entry = c.to_json()
+        err = c.weighted_abs_error_pct
+        reason = match_known_outlier(
+            known_outliers, c.workload,
+            abs_error_pct=err if math.isfinite(err) else None,
+        )
+        if reason is not None:
+            entry["known_outlier"] = reason
+        elif math.isfinite(err):
+            unexplained.append(err)
+        entries.append(entry)
     doc = {
         "mean_weighted_abs_error_pct": round(
             sum(finite) / len(finite), 2
         ) if finite else None,
-        "workloads": [c.to_json() for c in correlations],
+        "mean_excl_known_outliers_pct": round(
+            sum(unexplained) / len(unexplained), 2
+        ) if unexplained else None,
+        "workloads": entries,
     }
     path.write_text(json.dumps(doc, indent=2))
     return path
