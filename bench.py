@@ -53,6 +53,11 @@ SUITE = [
      {"vocab": 131072, "dim": 1024, "lookups": 8192}, 16),
     ("transcendental", {"elems": 8 * 1024 * 1024}, 16),
     ("lstm_layer", {"batch": 64, "hidden": 1024, "seq": 64}, 8),
+    # the inference-serving regime: batch-small matmuls + HBM-bound
+    # KV-cache attention + in-place DUS appends
+    ("decode_step",
+     {"batch": 8, "seq_cache": 1024, "heads": 8, "head_dim": 128,
+      "layers": 2, "pos": 512}, 16),
 ]
 
 ATTEMPTS = int(os.environ.get("TPUSIM_BENCH_ATTEMPTS", "3"))

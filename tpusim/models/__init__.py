@@ -20,5 +20,6 @@ from tpusim.models import attention as _attention  # noqa: F401
 from tpusim.models import moe as _moe  # noqa: F401
 from tpusim.models import pipeline as _pipeline  # noqa: F401
 from tpusim.models import pallas_attention as _pallas_attention  # noqa: F401
+from tpusim.models import decode as _decode  # noqa: F401
 
 __all__ = ["Workload", "get_workload", "list_workloads", "register"]

@@ -1,0 +1,103 @@
+"""Autoregressive KV-cache decode — the inference-serving workload class.
+
+The reference's benchmark suites cover training-shaped kernels; serving on
+TPU is dominated by a different regime: batch-small matmuls (MXU
+underutilized), attention over a long KV cache (HBM-bound reads of
+``[S, H, D]`` per layer), and in-place ``dynamic_update_slice`` cache
+writes.  This workload isolates that regime for timing correlation the
+same way ``lstm_layer`` isolates the RNN slot.
+
+TPU-idiomatic construction: stacked per-layer weights scanned with
+``lax.scan`` (one compiled layer body), static cache shapes with a
+position mask (no dynamic shapes under ``jit``), and caches threaded as
+scan xs/ys so XLA aliases the update in place.
+"""
+
+from __future__ import annotations
+
+from tpusim.models.registry import register
+
+__all__ = []
+
+
+def _build(batch: int, seq_cache: int, heads: int, head_dim: int,
+           layers: int, dtype: str, pos: int):
+    import jax
+    import jax.numpy as jnp
+
+    if not 0 <= pos < seq_cache:
+        # a clamped DUS write plus an all-true mask would silently return
+        # wrong attention at the cache-full boundary
+        raise ValueError(
+            f"pos={pos} must be in [0, seq_cache={seq_cache}) — the cache "
+            f"append writes at pos and the mask validates [0, pos]"
+        )
+
+    dt = jnp.dtype(dtype)
+    d_model = heads * head_dim
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 6)
+    scale = d_model ** -0.5
+
+    wq, wk, wv, wo = (
+        jax.random.normal(ks[i], (layers, d_model, d_model), dt) * scale
+        for i in range(4)
+    )
+    cache_k = jax.random.normal(
+        ks[4], (layers, batch, seq_cache, heads, head_dim), dt
+    )
+    cache_v = jax.random.normal(
+        ks[5], (layers, batch, seq_cache, heads, head_dim), dt
+    )
+    hidden = jax.random.normal(
+        jax.random.PRNGKey(7), (batch, d_model), dt
+    )
+
+    def step(hidden, cache_k, cache_v, pos, wq, wk, wv, wo):
+        """One decoded token through all layers; returns
+        (new_hidden, new_cache_k, new_cache_v, pos + 1)."""
+
+        def layer(h, xs):
+            lwq, lwk, lwv, lwo, kc, vc = xs
+            q = (h @ lwq).reshape(batch, heads, head_dim)
+            k = (h @ lwk).reshape(batch, heads, head_dim)
+            v = (h @ lwv).reshape(batch, heads, head_dim)
+            # in-place cache append at the current position (XLA aliases
+            # the dynamic-update-slice onto the carried buffer)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[:, None].astype(kc.dtype), (0, pos, 0, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[:, None].astype(vc.dtype), (0, pos, 0, 0)
+            )
+            scores = jnp.einsum(
+                "bhd,bshd->bhs", q, kc
+            ).astype(jnp.float32) * (head_dim ** -0.5)
+            valid = jnp.arange(seq_cache) <= pos          # static shape
+            scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhs,bshd->bhd", probs, vc)
+            h = h + attn.reshape(batch, d_model) @ lwo
+            return h, (kc, vc)
+
+        hidden, (cache_k, cache_v) = jax.lax.scan(
+            layer, hidden, (wq, wk, wv, wo, cache_k, cache_v)
+        )
+        return hidden, cache_k, cache_v, pos + 1
+
+    return step, (
+        hidden, cache_k, cache_v, jnp.int32(pos), wq, wk, wv, wo,
+    )
+
+
+@register(
+    "decode_step",
+    description="autoregressive KV-cache decode step (batch-small "
+    "matmuls + HBM-bound cache attention + in-place DUS appends — the "
+    "inference serving slot)",
+    suite="ubench",
+    batch=8, seq_cache=2048, heads=16, head_dim=128, layers=4,
+    dtype="bfloat16", pos=1024,
+)
+def build_decode_step(**kw):
+    return _build(**kw)
