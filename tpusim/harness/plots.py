@@ -103,23 +103,63 @@ def write_correlation_report(
     if png:
         (out / "correl.png").write_bytes(png)
 
-    rows = "\n".join(
-        "<tr><td>{}</td><td align=right>{:.1f}</td>"
-        "<td align=right>{:.1f}</td><td align=right>{:+.2f}%</td>"
-        "<td align=right>{:.3g}</td><td align=right>{:.3g}</td></tr>".format(
-            html.escape(p.name), p.real_seconds * 1e6, p.sim_seconds * 1e6,
-            p.error_pct, p.flops, p.hbm_bytes,
+    # curated understood deviations (known.correlation.outliers.list slot):
+    # annotated in the table, never removed from the stats
+    try:
+        from tpusim.harness.correl_ops import (
+            load_known_outliers, match_known_outlier,
         )
-        for p in sorted(points, key=lambda p: -p.abs_error_pct)
+
+        outliers = load_known_outliers()
+        known = {
+            p.name: match_known_outlier(
+                outliers, p.name, abs_error_pct=p.abs_error_pct,
+            )
+            for p in points
+        }
+    except Exception:
+        known = {}
+    unexplained = [
+        p.abs_error_pct for p in points if not known.get(p.name)
+    ]
+
+    def _row(p: CorrelationPoint) -> str:
+        reason = known.get(p.name)
+        note = (
+            f'<br><small title="{html.escape(reason)}">known outlier: '
+            f"{html.escape(reason[:60])}…</small>" if reason else ""
+        )
+        style = ' style="background:#fff6e0"' if reason else ""
+        return (
+            "<tr{style}><td>{name}{note}</td><td align=right>{real:.1f}"
+            "</td><td align=right>{sim:.1f}</td>"
+            "<td align=right>{err:+.2f}%</td><td align=right>{src}</td>"
+            "<td align=right>{fl:.3g}</td><td align=right>{hb:.3g}</td>"
+            "</tr>".format(
+                style=style, name=html.escape(p.name), note=note,
+                real=p.real_seconds * 1e6, sim=p.sim_seconds * 1e6,
+                err=p.error_pct, src=html.escape(p.real_source),
+                fl=p.flops, hb=p.hbm_bytes,
+            )
+        )
+
+    rows = "\n".join(
+        _row(p) for p in sorted(points, key=lambda p: -p.abs_error_pct)
     )
     corr = stats.get("log_correlation")
     summary = (
         "<p><b>{n}</b> workloads — mean |error| "
         "<b>{mean:.2f}%</b>, max |error| {mx:.2f}%, "
-        "log-time correlation {corr}</p>".format(
+        "log-time correlation {corr}{excl}</p>".format(
             n=stats["n"], mean=stats["mean_abs_error_pct"],
             mx=stats["max_abs_error_pct"],
             corr=f"{corr:.4f}" if corr is not None else "n/a",
+            excl=(
+                "; excluding known outliers: "
+                f"<b>{sum(unexplained) / len(unexplained):.2f}%</b> "
+                f"({len(unexplained)} workloads)"
+                if unexplained and len(unexplained) != stats["n"] else ""
+            ),
         )
         if stats.get("n") else "<p>no points</p>"
     )
@@ -146,7 +186,7 @@ collapse}}td,th{{border:1px solid #ccc;padding:4px 10px}}</style></head>
 <h2>per-workload</h2>
 <table>
 <tr><th>workload</th><th>silicon µs/step</th><th>sim µs/step</th>
-<th>error</th><th>flops/step</th><th>hbm B/step</th></tr>
+<th>error</th><th>truth</th><th>flops/step</th><th>hbm B/step</th></tr>
 {rows}
 </table>
 </body></html>
