@@ -14,6 +14,7 @@ swappable, mirroring the reference's ``exec_*`` vs ``trace_*`` class split
 from __future__ import annotations
 
 import enum
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Iterator
@@ -72,11 +73,14 @@ class TensorSpec:
     def rank(self) -> int:
         return len(self.shape)
 
-    @property
+    @functools.cached_property
     def elems(self) -> int:
+        # cached: the schedule walk re-reads sizes tens of thousands of
+        # times per run (cached_property writes to __dict__ directly,
+        # which frozen dataclasses permit)
         return math.prod(self.shape) if self.shape else 1
 
-    @property
+    @functools.cached_property
     def nbytes(self) -> int:
         if self.dtype in ("token", "opaque"):
             return 0
@@ -93,11 +97,11 @@ class TupleSpec:
 
     parts: tuple["TensorSpec | TupleSpec", ...] = ()
 
-    @property
+    @functools.cached_property
     def nbytes(self) -> int:
         return sum(p.nbytes for p in self.parts)
 
-    @property
+    @functools.cached_property
     def elems(self) -> int:
         return sum(p.elems for p in self.parts)
 

@@ -19,6 +19,7 @@ computations — the analogue of the per-fusion problem called out as the
 
 from __future__ import annotations
 
+import itertools
 import math
 import re
 from dataclasses import dataclass, field
@@ -431,12 +432,16 @@ class OpCost:
     is_async: bool = False
     #: bytes_accessed from a kernel's own cost estimate (-1 = none)
     est_bytes: float = -1.0
+    #: True when a recursion-depth cutoff clipped part of this total —
+    #: such totals are incomplete and must not be memoized
+    truncated: bool = False
 
     def add_compute(self, other: "OpCost") -> None:
         self.compute_cycles += other.compute_cycles
         self.flops += other.flops
         self.mxu_flops += other.mxu_flops
         self.transcendentals += other.transcendentals
+        self.truncated = self.truncated or other.truncated
 
 
 # ---------------------------------------------------------------------------
@@ -449,6 +454,15 @@ class CostModel:
     arch: ArchConfig
     #: per-custom-call-target achieved-FLOP/s override (e.g. pallas kernels)
     custom_call_flops: dict[str, float] = field(default_factory=dict)
+    #: unique, never-reused token for this model instance — fusion-cost
+    #: cache keys use it so entries can't alias across models with
+    #: different arch parameters (an id() would be reusable after GC).
+    #: init=False/compare=False: dataclasses.replace/copy must mint a
+    #: fresh token, and tokens must not break CostModel equality
+    _cache_token: int = field(
+        default_factory=itertools.count().__next__,
+        init=False, compare=False, repr=False,
+    )
 
     # -- MXU systolic-pass model ------------------------------------------
 
@@ -601,9 +615,24 @@ class CostModel:
     def fused_compute_cost(
         self, module: ModuleTrace, comp_name: str, depth: int = 0
     ) -> OpCost:
-        """Aggregate compute cost of a fused computation (recursive)."""
+        """Aggregate compute cost of a fused computation (recursive,
+        memoized per module+computation — callers only read the result
+        via :meth:`OpCost.add_compute`)."""
         if depth > 16:
-            return OpCost()
+            return OpCost(truncated=True)
+        # cache lives ON the module (unhashable dataclass; the cache dies
+        # with the object), keyed by this model's unique token so two
+        # CostModels with different configs never share entries
+        per_module = getattr(module, "_fusion_cost_cache", None)
+        if per_module is None:
+            per_module = {}
+            try:
+                module._fusion_cost_cache = per_module
+            except (AttributeError, TypeError):
+                per_module = None
+        key = (self._cache_token, comp_name)
+        if per_module is not None and key in per_module:
+            return per_module[key]
         total = OpCost()
         if comp_name not in module.computations:
             return total
@@ -611,6 +640,10 @@ class CostModel:
         for op in comp.ops:
             inner = self._compute_cost(op, comp, module, depth)
             total.add_compute(inner)
+        if per_module is not None and not total.truncated:
+            # a depth-clipped subtree total is partial; caching it would
+            # serve the undercount to shallow-depth callers forever
+            per_module[key] = total
         return total
 
     # -- full op cost ------------------------------------------------------

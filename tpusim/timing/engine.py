@@ -241,6 +241,24 @@ def _vmem_resident_bytes(module: ModuleTrace) -> float:
     return total
 
 
+def _residency_of(module: ModuleTrace) -> float:
+    """Memoized vmem residency, cached ON the module (it is immutable
+    after parse, and being an eq-based dataclass it is unhashable — no
+    dict keying).  The scan was ~30% of a small-module replay.  Lazy
+    modules provide a raw-text S(1) scan so the check doesn't force a
+    full parse."""
+    cached = getattr(module, "_residency_cache", None)
+    if cached is not None:
+        return cached
+    fast = getattr(module, "vmem_resident_bytes", None)
+    resident = fast() if callable(fast) else _vmem_resident_bytes(module)
+    try:
+        module._residency_cache = resident
+    except (AttributeError, TypeError):
+        pass
+    return resident
+
+
 class Engine:
     """Times one module on one modeled device of a topology."""
 
@@ -273,11 +291,7 @@ class Engine:
         result = EngineResult()
         spill_frac = 1.0
         if self.config.model_vmem_capacity:
-            # lazy modules provide a raw-text S(1) scan so the capacity
-            # check doesn't force a full parse of every computation
-            fast = getattr(module, "vmem_resident_bytes", None)
-            resident = fast() if callable(fast) \
-                else _vmem_resident_bytes(module)
+            resident = _residency_of(module)
             result.vmem_resident_bytes = resident
             cap = float(self.arch.vmem_bytes)
             if resident > cap > 0:
