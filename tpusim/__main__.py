@@ -240,9 +240,21 @@ def _cmd_tune(args: argparse.Namespace) -> int:
         return 0
     result = tune(args.arch)
     print(json.dumps(dataclasses.asdict(result), indent=2))
-    if args.out:
-        write_overlay(result, args.out)
-        print(f"overlay written to {args.out}")
+    out = args.out
+    if out == "auto":
+        # the canonical location load_config applies by default — running
+        # the tuner IS closing the loop (tested-cfgs, util/tuner/tuner.py)
+        from pathlib import Path
+
+        out = (
+            Path(__file__).resolve().parents[1]
+            / "configs" / f"{result.base_arch}.tuned.flags"
+        )
+        out.parent.mkdir(parents=True, exist_ok=True)
+    if out:
+        write_overlay(result, out)
+        print(f"overlay written to {out} (load_config applies "
+              f"configs/<arch>.tuned.flags automatically)")
     return 0
 
 
@@ -365,7 +377,12 @@ def main(argv: list[str] | None = None) -> int:
         "tune", help="fit arch parameters on the local chip (tuner)"
     )
     pt.add_argument("--arch", default=None)
-    pt.add_argument("--out", default=None, help="write a config overlay here")
+    pt.add_argument(
+        "--out", default="auto",
+        help="overlay destination; 'auto' (default) = the canonical "
+             "configs/<arch>.tuned.flags that load_config applies; "
+             "'' disables writing",
+    )
     pt.add_argument("--power", action="store_true",
                     help="fit power coefficients instead (telemetry when "
                          "available, anchor fixtures otherwise)")
