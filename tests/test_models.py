@@ -246,3 +246,34 @@ print("DECODE_SIM_OK")
         n_devices=1,
     )
     assert "DECODE_SIM_OK" in out
+
+
+DECODE_TP_SCRIPT = r"""
+import jax, jax.numpy as jnp
+import numpy as np
+from tpusim.models import get_workload
+from tpusim.models.decode import _build
+
+kw = dict(batch=2, seq_cache=32, heads=8, head_dim=8, layers=2,
+          dtype="float32", pos=7)
+tp_step, tp_args = get_workload("decode_step_tp8").build(tp=8, **kw)
+h_tp, ck_tp, cv_tp, pos_tp = jax.jit(tp_step)(*tp_args)
+
+ref_step, ref_args = _build(**kw)
+h_ref, ck_ref, cv_ref, pos_ref = jax.jit(ref_step)(*ref_args)
+
+# head-sharded attention + psum'd output projection must reproduce the
+# single-chip step exactly (same seeds build identical weights/caches)
+assert np.allclose(np.asarray(h_tp), np.asarray(h_ref), atol=1e-4), (
+    np.abs(np.asarray(h_tp) - np.asarray(h_ref)).max()
+)
+assert np.allclose(np.asarray(ck_tp), np.asarray(ck_ref), atol=1e-5)
+assert int(pos_tp) == int(pos_ref) == 8
+print("DECODE_TP_OK")
+"""
+
+
+@pytest.mark.slow
+def test_decode_tp8_matches_single_chip():
+    out = run_in_cpu_mesh(DECODE_TP_SCRIPT, n_devices=8)
+    assert "DECODE_TP_OK" in out

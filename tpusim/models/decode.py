@@ -101,3 +101,90 @@ def _build(batch: int, seq_cache: int, heads: int, head_dim: int,
 )
 def build_decode_step(**kw):
     return _build(**kw)
+
+
+def _build_tp(batch: int, seq_cache: int, heads: int, head_dim: int,
+              layers: int, dtype: str, pos: int, tp: int):
+    """Tensor-parallel decode: heads (and their KV cache shards) live on
+    different chips; the output projection's partial sums meet in a psum.
+    The serving analogue of Megatron TP — each step's collective is ONE
+    [B, d_model] all-reduce per layer, the pattern whose latency bounds
+    multi-chip serving."""
+    import numpy as np
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if heads % tp:
+        raise ValueError(f"heads={heads} must divide by tp={tp}")
+    devs = np.array(jax.devices()[:tp])
+    mesh = Mesh(devs, ("tp",))
+
+    step, (hidden, ck, cv, pos_a, wq, wk, wv, wo) = _build(
+        batch, seq_cache, heads, head_dim, layers, dtype, pos,
+    )
+    d_model = heads * head_dim
+    h_loc = heads // tp
+    d_loc = h_loc * head_dim
+
+    def shard_step(hidden, ck, cv, pos_a, wq, wk, wv, wo):
+        # local shard shapes: qkv projections [L, d, d_loc], caches
+        # [L, B, S, h_loc, D], wo [L, d_loc, d]
+        local_heads = h_loc
+
+        def layer(h, xs):
+            lwq, lwk, lwv, lwo, kc, vc = xs
+            q = (h @ lwq).reshape(batch, local_heads, head_dim)
+            k = (h @ lwk).reshape(batch, local_heads, head_dim)
+            v = (h @ lwv).reshape(batch, local_heads, head_dim)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[:, None].astype(kc.dtype), (0, pos_a, 0, 0)
+            )
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[:, None].astype(vc.dtype), (0, pos_a, 0, 0)
+            )
+            scores = jnp.einsum(
+                "bhd,bshd->bhs", q, kc
+            ).astype(jnp.float32) * (head_dim ** -0.5)
+            valid = jnp.arange(seq_cache) <= pos_a
+            scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhs,bshd->bhd", probs, vc)
+            # partial output projection from this chip's heads; the
+            # all-reduce completes the sum — Megatron's g-operator
+            partial_out = attn.reshape(batch, d_loc) @ lwo
+            h = h + jax.lax.psum(partial_out, "tp")
+            return h, (kc, vc)
+
+        hidden, (ck, cv) = jax.lax.scan(
+            layer, hidden, (wq, wk, wv, wo, ck, cv)
+        )
+        return hidden, ck, cv, pos_a + 1
+
+    sharded = partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(
+            P(), P(None, None, None, "tp"), P(None, None, None, "tp"),
+            P(), P(None, None, "tp"), P(None, None, "tp"),
+            P(None, None, "tp"), P(None, "tp"),
+        ),
+        out_specs=(P(), P(None, None, None, "tp"),
+                   P(None, None, None, "tp"), P()),
+    )(shard_step)
+
+    return sharded, (hidden, ck, cv, pos_a, wq, wk, wv, wo)
+
+
+@register(
+    "decode_step_tp8",
+    description="tensor-parallel KV-cache decode over 8 chips (heads + "
+    "cache sharded, one psum per layer — multi-chip serving latency)",
+    suite="models",
+    num_devices=8,
+    batch=8, seq_cache=4096, heads=16, head_dim=128, layers=4,
+    dtype="bfloat16", pos=2048, tp=8,
+)
+def build_decode_step_tp(**kw):
+    return _build_tp(**kw)
