@@ -181,6 +181,87 @@ def child_main() -> int:
         except Exception as e:  # keep the suite alive; report what ran
             log(f"bench: {name} FAILED: {type(e).__name__}: {e}")
 
+    # self-validate the fit before it becomes the committed config: replay
+    # the just-captured fixtures (same silicon truths) with tuned vs
+    # preset parameters; a tuned overlay that WORSENS correlation is
+    # renamed *.rejected instead of silently poisoning every later run —
+    # the reference only ships tuner output as tested-cfgs after
+    # re-validation (Jenkinsfile correlation publish)
+    preset_rows = None
+    if tuned_info and fixture_entries:
+        try:
+            from tpusim.timing.arch import detect_arch
+            from tpusim.timing.config import load_config
+            from tpusim.timing.engine import Engine
+
+            arch_name = detect_arch(dev.device_kind).name
+            means = {}
+            rows_by = {}
+            for label, tuned_flag in (("tuned", True), ("preset", False)):
+                eng = Engine(load_config(arch=arch_name, tuned=tuned_flag))
+                rows = replay_fixture_errors(
+                    eng, fixture_entries, FIXTURE_DIR,
+                )
+                if rows:
+                    rows_by[label] = rows
+            if "tuned" in rows_by and "preset" in rows_by:
+                # compare over the INTERSECTION of successfully replayed
+                # workloads: pathological tuned parameters that crash the
+                # replay of the worst workload must not win by averaging
+                # over an easier subset
+                common = (
+                    {r[0] for r in rows_by["tuned"]}
+                    & {r[0] for r in rows_by["preset"]}
+                )
+                for label, rows in rows_by.items():
+                    kept = [r for r in rows if r[0] in common]
+                    if kept:
+                        means[label] = (
+                            sum(abs(r[3]) for r in kept) / len(kept)
+                        )
+                dropped_t = len(rows_by["tuned"]) - len(common)
+                dropped_p = len(rows_by["preset"]) - len(common)
+                if dropped_t or dropped_p or not common:
+                    log(
+                        f"bench: overlay validation subset: "
+                        f"{len(common)} common workloads "
+                        f"(tuned dropped {dropped_t}, preset dropped "
+                        f"{dropped_p})"
+                    )
+            else:
+                log("bench: overlay validation skipped — one side "
+                    "returned no replayable rows")
+            if "tuned" in means and "preset" in means:
+                tuned_info["replay_mean_abs_err_pct"] = {
+                    k: round(v, 2) for k, v in means.items()
+                }
+                if means["tuned"] > means["preset"] + 1.0:
+                    op = Path(REPO_ROOT / tuned_info["overlay"])
+                    rejected_path = op.with_suffix(op.suffix + ".rejected")
+                    op.rename(rejected_path)
+                    tuned_info["rejected"] = True
+                    tuned_info["overlay"] = str(
+                        rejected_path.relative_to(REPO_ROOT)
+                    )
+                    # the suite's points were simulated WITH the bad
+                    # overlay; the headline must reflect the config that
+                    # survives (the preset replay, same silicon truths)
+                    preset_rows = rows_by["preset"]
+                    log(
+                        f"bench: tuned overlay REJECTED (replay "
+                        f"{means['tuned']:.1f}% vs preset "
+                        f"{means['preset']:.1f}%); kept as {op}.rejected"
+                    )
+                else:
+                    log(
+                        f"bench: tuned overlay validated (replay "
+                        f"{means['tuned']:.1f}% vs preset "
+                        f"{means['preset']:.1f}%)"
+                    )
+        except Exception as e:
+            log(f"bench: overlay self-validation FAILED: "
+                f"{type(e).__name__}: {e}")
+
     if save_fixtures and fixture_entries:
         try:
             from tpusim.timing.arch import detect_arch
@@ -204,23 +285,52 @@ def child_main() -> int:
         })
         return 1
 
-    mean_abs = sum(p.abs_error_pct for p in points) / len(points)
-    out = {
-        "metric": "sim_cycle_error_pct",
-        "value": round(mean_abs, 3),
-        "unit": "%",
-        "vs_baseline": round(mean_abs / 15.0, 4),
-        "source": "live",
-        "detail": {
+    if preset_rows is not None:
+        # tuned overlay was rejected: the headline AND the committed
+        # report reflect the surviving (preset) config, replayed against
+        # the same silicon truths — the artifact must substantiate the
+        # number it backs
+        from tpusim.harness.correlate import CorrelationPoint
+
+        points = [
+            CorrelationPoint(
+                name=r[0], sim_seconds=r[1], real_seconds=r[2],
+                sim_cycles=0.0, flops=r[5], hbm_bytes=r[6],
+                real_source=r[4],
+            )
+            for r in preset_rows
+        ]
+        mean_abs = sum(abs(r[3]) for r in preset_rows) / len(preset_rows)
+        detail = {
+            name: {
+                "sim_us": round(sim_s * 1e6, 1),
+                "real_us": round(real_s * 1e6, 1),
+                "err_pct": round(err, 2),
+                "real_source": src,
+            }
+            for name, sim_s, real_s, err, src, _fl, _hb in preset_rows
+        }
+        n_workloads = len(preset_rows)
+    else:
+        mean_abs = sum(p.abs_error_pct for p in points) / len(points)
+        detail = {
             p.name: {
                 "sim_us": round(p.sim_seconds * 1e6, 1),
                 "real_us": round(p.real_seconds * 1e6, 1),
                 "err_pct": round(p.error_pct, 2),
             }
             for p in points
-        },
+        }
+        n_workloads = len(points)
+    out = {
+        "metric": "sim_cycle_error_pct",
+        "value": round(mean_abs, 3),
+        "unit": "%",
+        "vs_baseline": round(mean_abs / 15.0, 4),
+        "source": "live",
+        "detail": detail,
         "device": dev.device_kind,
-        "workloads": len(points),
+        "workloads": n_workloads,
         "real_source": sorted({p.real_source for p in points}),
         **({"tuned": tuned_info} if tuned_info else {}),
     }
@@ -270,6 +380,47 @@ def child_main() -> int:
 # fallback: committed silicon fixtures (pure sim — NO jax import)
 # --------------------------------------------------------------------------
 
+def replay_fixture_errors(
+    engine, entries: list[dict], fixture_dir: Path,
+) -> list[tuple[str, float, float, float, str, float, float]]:
+    """Replay fixture traces through one engine; returns
+    (name, sim_s, real_s, signed_err_pct, real_source, flops_per_step,
+    hbm_bytes_per_step) per entry that replays successfully.  Shared by
+    the offline fallback and the live child's tuned-overlay
+    self-validation."""
+    from tpusim.trace.format import load_trace
+
+    out = []
+    for entry in entries:
+        name = entry["name"]
+        try:
+            td = load_trace(fixture_dir / entry["trace"])
+            want = entry.get("module")
+            if want is not None:
+                mod = td.modules[want]
+            elif len(td.modules) == 1:
+                mod = next(iter(td.modules.values()))
+            else:
+                raise ValueError(
+                    f"trace has {len(td.modules)} modules "
+                    f"({sorted(td.modules)}); manifest entry must name "
+                    f"one via 'module'"
+                )
+            res = engine.run(mod)
+            n_steps = float(entry.get("n_steps", 1))
+            sim_s = res.seconds / n_steps
+            real_s = float(entry["real_seconds"])
+            err = 100.0 * (sim_s - real_s) / real_s
+            out.append((
+                name, sim_s, real_s, err,
+                entry.get("real_source", "wall"),
+                res.flops / n_steps, res.hbm_bytes / n_steps,
+            ))
+        except Exception as e:
+            log(f"bench(replay): {name} FAILED: {type(e).__name__}: {e}")
+    return out
+
+
 def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     """Replay committed TPU traces against their committed measured times.
 
@@ -280,7 +431,6 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
 
     from tpusim.timing.config import load_config
     from tpusim.timing.engine import Engine
-    from tpusim.trace.format import load_trace
 
     manifest = json.loads(manifest_path.read_text())
     arch = manifest.get("arch", "v5e")
@@ -299,48 +449,28 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
 
     detail = {}
     errs = []
-    for entry in manifest.get("workloads", []):
-        name = entry["name"]
-        try:
-            td = load_trace(fixture_dir / entry["trace"])
-            want = entry.get("module")
-            if want is not None:
-                mod = td.modules[want]
-            elif len(td.modules) == 1:
-                mod = next(iter(td.modules.values()))
-            else:
-                raise ValueError(
-                    f"trace has {len(td.modules)} modules "
-                    f"({sorted(td.modules)}); manifest entry must name one "
-                    f"via 'module'"
-                )
-            res = engine.run(mod)
-            n_steps = float(entry.get("n_steps", 1))
-            sim_s = res.seconds / n_steps
-            real_s = float(entry["real_seconds"])
-            # ground-truth provenance: entries captured before the
-            # device-timeline change (or where the profiler failed) hold
-            # wall-clock times inflated by per-launch dispatch gaps
-            src = entry.get("real_source", "wall")
-            err = 100.0 * (sim_s - real_s) / real_s
-            errs.append(abs(err))
-            detail[name] = {
-                "sim_us": round(sim_s * 1e6, 1),
-                "real_us": round(real_s * 1e6, 1),
-                "err_pct": round(err, 2),
-                "real_source": src,
-            }
-            if known_outliers and match_known_outlier is not None:
-                reason = match_known_outlier(
-                    known_outliers, name, abs_error_pct=abs(err),
-                )
-                if reason is not None:
-                    detail[name]["known_outlier"] = reason
-            log(f"bench(fixture): {name:24s} sim={sim_s * 1e6:9.1f}us "
-                f"real={real_s * 1e6:9.1f}us err={err:+7.2f}%"
-                + ("  [wall-sourced truth]" if src != "device" else ""))
-        except Exception as e:
-            log(f"bench(fixture): {name} FAILED: {type(e).__name__}: {e}")
+    for name, sim_s, real_s, err, src, _fl, _hb in replay_fixture_errors(
+        engine, manifest.get("workloads", []), fixture_dir,
+    ):
+        # ground-truth provenance: entries captured before the
+        # device-timeline change (or where the profiler failed) hold
+        # wall-clock times inflated by per-launch dispatch gaps
+        errs.append(abs(err))
+        detail[name] = {
+            "sim_us": round(sim_s * 1e6, 1),
+            "real_us": round(real_s * 1e6, 1),
+            "err_pct": round(err, 2),
+            "real_source": src,
+        }
+        if known_outliers and match_known_outlier is not None:
+            reason = match_known_outlier(
+                known_outliers, name, abs_error_pct=abs(err),
+            )
+            if reason is not None:
+                detail[name]["known_outlier"] = reason
+        log(f"bench(fixture): {name:24s} sim={sim_s * 1e6:9.1f}us "
+            f"real={real_s * 1e6:9.1f}us err={err:+7.2f}%"
+            + ("  [wall-sourced truth]" if src != "device" else ""))
 
     if not errs:
         return None
