@@ -159,14 +159,21 @@ def child_main() -> int:
     fixture_entries = []
 
     points = []
+    op_profiles: list[tuple[str, dict]] = []
     for name, overrides, n_steps in SUITE:
         try:
             fn, args = get_workload(name).build(**overrides)
+            prof: dict = {}
             pt = correlate_workload(
                 fn, args, name=name, n_steps=n_steps, iters=3,
                 fixture_dir=FIXTURE_DIR if save_fixtures else None,
+                op_profile_out=prof,
             )
             points.append(pt)
+            if prof.get("ops"):
+                # one device trace serves both the truth and the per-op
+                # correlation — no second profiling pass per workload
+                op_profiles.append((name, prof))
             if save_fixtures:
                 fixture_entries.append({
                     "name": name, "trace": name, "n_steps": n_steps,
@@ -351,16 +358,24 @@ def child_main() -> int:
             log(f"bench: report FAILED: {type(e).__name__}: {e}")
         try:
             from tpusim.harness.correl_ops import (
-                correlate_workload_ops, write_correl_ops,
+                correlate_counters, correlate_ops, write_correl_ops,
             )
 
+            # assembled from the SAME device traces that produced the
+            # headline truths — no second profiling pass over the suite
             op_corrs = []
-            for name, overrides, _steps in SUITE:
+            for name, prof in op_profiles:
                 try:
-                    fn, args = get_workload(name).build(**overrides)
-                    op_corrs.append(correlate_workload_ops(
-                        fn, args, name=name,
-                    ))
+                    corr = correlate_ops(
+                        prof["engine_result"], prof["ops"],
+                        clock_hz=prof["clock_hz"], workload=name,
+                        real_iters=prof["iters"],
+                    )
+                    corr.counters = correlate_counters(
+                        prof["engine_result"], prof["ops"],
+                        clock_hz=prof["clock_hz"], arch=prof["arch"],
+                    )
+                    op_corrs.append(corr)
                 except Exception as e:
                     log(f"bench: correl_ops {name} FAILED: "
                         f"{type(e).__name__}: {e}")
@@ -368,7 +383,12 @@ def child_main() -> int:
                 p = write_correl_ops(
                     op_corrs, Path(report_dir) / "correl_ops.json"
                 )
-                log(f"bench: per-op correlation written to {p}")
+                log(f"bench: per-op correlation written to {p} "
+                    f"({len(op_corrs)} workloads)")
+            else:
+                log("bench: no per-op profiles collected (device "
+                    "profiling unavailable?); correl_ops.json not "
+                    "refreshed")
         except Exception as e:
             log(f"bench: correl_ops FAILED: {type(e).__name__}: {e}")
 

@@ -261,7 +261,8 @@ def measure_device_time(
     iters: int = 3,
     warmup: int = 2,
     log_dir: str | Path | None = None,
-) -> dict[str, float]:
+    with_ops: bool = False,
+) -> dict[str, Any]:
     """Measure per-execution DEVICE time via the profiler's module
     timeline (the nvprof-``Duration`` equivalent; the reference
     correlates against kernel durations, not wall clock —
@@ -269,26 +270,34 @@ def measure_device_time(
 
     Returns the median over ``iters`` executions (one outlier hit by
     host interference must not skew the truth the way a mean would).
+    With ``with_ops=True`` the SAME captured xplane also yields the
+    per-instruction profile under the ``"ops"`` key — one device trace
+    serves both the whole-program truth and the per-op correlation (a
+    fragile tunnel should not be asked to profile everything twice).
     Raises when the profile contains no device module events (e.g. CPU
     backend) — callers fall back to fenced wall time."""
     import statistics
     import tempfile
 
-    def _run(trace_dir: str | Path) -> dict[str, float]:
-        mods = extract_module_events(
-            _trace_capture(fn, args, trace_dir, warmup=warmup, iters=iters)
+    def _run(trace_dir: str | Path) -> dict[str, Any]:
+        xplane = _trace_capture(
+            fn, args, trace_dir, warmup=warmup, iters=iters,
         )
+        mods = extract_module_events(xplane)
         if not mods:
             raise RuntimeError(
                 "no device-plane XLA Modules events in profile; "
                 "use wall-clock timing"
             )
         name, durs = max(mods.items(), key=lambda kv: sum(kv[1]))
-        return {
+        out: dict[str, Any] = {
             "median_s": statistics.median(durs) / 1e9,
             "n_exec": float(len(durs)),
-            "module": name,  # type: ignore[dict-item]
+            "module": name,
         }
+        if with_ops:
+            out["ops"] = extract_op_profile(xplane)
+        return out
 
     if log_dir is not None:
         return _run(log_dir)

@@ -118,13 +118,20 @@ def correlate_workload(
     arch: str | None = None,
     iters: int = 3,
     fixture_dir: Any | None = None,
+    op_profile_out: dict | None = None,
 ) -> CorrelationPoint:
     """Capture, simulate, and silicon-time one workload; returns the point.
 
     ``arch=None`` auto-detects from the local device kind.  With
     ``fixture_dir`` set, the captured trace is also written to
     ``<fixture_dir>/<name>`` so the measurement can be replayed offline
-    (bench.py's silicon-fixture fallback)."""
+    (bench.py's silicon-fixture fallback).  With ``op_profile_out`` (a
+    dict) the device-time profile is reused for per-op correlation: the
+    dict is filled with ``ops`` (per-instruction silicon durations from
+    the SAME xplane that produced the truth), ``engine_result``,
+    ``clock_hz``, ``arch`` and ``iters`` — callers feed these straight
+    into :func:`tpusim.harness.correl_ops.correlate_ops` without
+    profiling the workload a second time."""
     import jax
 
     from tpusim.timing.arch import detect_arch
@@ -166,8 +173,17 @@ def correlate_workload(
         try:
             from tpusim.harness.correl_ops import measure_device_time
 
-            t = measure_device_time(looped, *args, iters=iters)
+            t = measure_device_time(
+                looped, *args, iters=iters,
+                with_ops=op_profile_out is not None,
+            )
             real_source = "device"
+            if op_profile_out is not None and "ops" in t:
+                op_profile_out.update(
+                    ops=t["ops"], engine_result=res,
+                    clock_hz=cfg.arch.clock_hz, arch=cfg.arch,
+                    iters=iters,
+                )
         except Exception as e:
             import sys
 
