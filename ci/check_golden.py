@@ -39,7 +39,7 @@ MATRIX = [
 ]
 
 #: host-dependent stats excluded from comparison
-VOLATILE = {"simulation_rate_kops", "wall_seconds"}
+VOLATILE = {"simulation_rate_kops", "wall_seconds", "silicon_slowdown"}
 #: relative tolerance for derived float stats
 RTOL = 1e-9
 

@@ -69,6 +69,16 @@ class SimReport:
             return 0.0
         return self.totals.op_count / self.wall_seconds / 1e3
 
+    def silicon_slowdown(self, arch_clock_hz: float) -> float:
+        """Host-seconds per simulated device-second — the
+        ``gpgpu_silicon_slowdown`` analogue (``gpgpusim_entrypoint.cc:
+        262-268`` prints it every run).  <1 means the simulator runs
+        faster than the hardware it models."""
+        sim_s = self.cycles / arch_clock_hz if arch_clock_hz > 0 else 0.0
+        if sim_s <= 0:
+            return 0.0
+        return self.wall_seconds / sim_s
+
     def finalize(self, arch_clock_hz: float) -> None:
         # totals accumulates per-kernel counters; its wall-clock view is the
         # pod's critical path, needed for the derived utilization stats
@@ -82,6 +92,7 @@ class SimReport:
         s.set("memcpy_cycles", self.memcpy_cycles)
         s.set("collective_cmd_cycles", self.collective_cmd_cycles)
         s.set("simulation_rate_kops", self.sim_rate_kops)
+        s.set("silicon_slowdown", self.silicon_slowdown(arch_clock_hz))
         s.update(self.totals.stats_dict(), prefix="tot_")
 
     def print_report(self, out=None) -> None:
