@@ -375,3 +375,54 @@ def test_known_outlier_edge_cases(tmp_path):
     assert match_known_outlier(
         [{"workload": "*", "reason": "r"}], "anything", abs_error_pct=1.0,
     ) == "r"
+
+
+# the op_profile_out plumbing runs unattended at round end (live bench);
+# exercise it off-TPU with measure_device_time stubbed
+PROFILE_REUSE_SCRIPT = r"""
+import os
+os.environ["TPUSIM_FORCE_DEVICE_TIMING"] = "1"
+
+import tpusim.harness.correl_ops as co
+
+def fake_mdt(fn, *args, iters=3, warmup=2, log_dir=None, with_ops=False):
+    d = {"median_s": 1e-3, "n_exec": 3.0, "module": "jit_loop"}
+    if with_ops:
+        d["ops"] = {"dot.1": co.OpSilicon("dot.1", count=3.0,
+                                          total_ns=3000.0)}
+    return d
+
+co.measure_device_time = fake_mdt
+
+from tpusim.harness.correlate import correlate_workload
+from tpusim.models import get_workload
+
+fn, args = get_workload("matmul").build(m=64, n=64, k=64)
+prof = {}
+pt = correlate_workload(fn, args, name="m", n_steps=2, arch="v5e",
+                        iters=3, op_profile_out=prof)
+assert pt.real_source == "device", pt.real_source
+assert pt.real_seconds == 1e-3 / 2
+assert "ops" in prof and "dot.1" in prof["ops"]
+assert prof["engine_result"].cycles > 0
+assert prof["clock_hz"] > 0 and prof["arch"].name == "v5e"
+assert prof["iters"] == 3
+
+# the assembled artifact path the bench child runs
+corr = co.correlate_ops(
+    prof["engine_result"], prof["ops"], clock_hz=prof["clock_hz"],
+    workload="m", real_iters=prof["iters"],
+)
+corr.counters = co.correlate_counters(
+    prof["engine_result"], prof["ops"], clock_hz=prof["clock_hz"],
+    arch=prof["arch"],
+)
+assert isinstance(corr.counters, dict)
+print("PROFILE_REUSE_OK")
+"""
+
+
+@pytest.mark.slow
+def test_op_profile_reuse_plumbing(cpu_mesh_runner):
+    out = cpu_mesh_runner(PROFILE_REUSE_SCRIPT, n_devices=1)
+    assert "PROFILE_REUSE_OK" in out

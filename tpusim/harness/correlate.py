@@ -169,7 +169,16 @@ def correlate_workload(
     # 408µs/step device).
     real_source = "wall"
     t = None
-    if jax.devices()[0].platform == "tpu":
+    import os as _os
+
+    # TPUSIM_FORCE_DEVICE_TIMING=1 lets tests drive the device-timing
+    # path off-TPU (with measure_device_time stubbed); the path otherwise
+    # only runs unattended at round end, where a silent break would cost
+    # the correl_ops artifact
+    if (
+        jax.devices()[0].platform == "tpu"
+        or _os.environ.get("TPUSIM_FORCE_DEVICE_TIMING") == "1"
+    ):
         try:
             from tpusim.harness.correl_ops import measure_device_time
 
