@@ -21,6 +21,22 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
 
+def pytest_sessionstart(session):
+    """Build the native library before any test can cache a negative load.
+
+    ``libtpusim_native.so`` is a build artifact (untracked); on a fresh
+    checkout, tests that run before tests/test_native.py's build fixture
+    would otherwise cache ``_LIB = None`` in tpusim.trace.native /
+    tpusim.ici.detailed and the availability assertions fail spuriously.
+    Best-effort: the pure-Python fallbacks keep everything else working."""
+    try:
+        subprocess.run(
+            ["make", "-C", str(REPO_ROOT / "native")], capture_output=True
+        )
+    except OSError:
+        pass
+
+
 @pytest.fixture(scope="session")
 def repo_root() -> Path:
     return REPO_ROOT
