@@ -1,0 +1,258 @@
+"""Tests for the silicon-calibrated cost-model mechanisms added in
+round 4's second calibration pass.  Each mechanism exists because a
+committed device-timeline fixture contradicted the previous model
+(``reports/correl_ops.json``); the test pins the mechanism, the tuned
+overlay pins the numbers.
+
+Reference slot: the per-unit latency tables the reference validates per
+card (``trace.config``, ``trace_driven.cc:385-480``)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tpusim.ir import Unit
+from tpusim.timing.config import ArchConfig, SimConfig, overlay
+from tpusim.timing.cost import CostModel
+from tpusim.timing.engine import Engine
+from tpusim.trace.hlo_text import parse_hlo_module
+
+
+def _module(body: str, params: str, ret: str) -> str:
+    return (
+        "HloModule m, is_scheduled=true\n\n"
+        f"ENTRY %main ({params}) -> {ret} {{\n{body}\n}}\n"
+    )
+
+
+def _run_entry_op(text: str, op_name: str = "c", cfg: SimConfig | None = None):
+    mod = parse_hlo_module(text)
+    cfg = cfg or SimConfig()
+    cm = CostModel(cfg.arch)
+    entry = mod.entry
+    return cm.op_cost(entry.op(op_name), entry, mod)
+
+
+# -- free custom-call markers ----------------------------------------------
+
+def test_marker_custom_calls_are_free():
+    """ConcatBitcast/AllocateBuffer/AssumeGatherIndicesInBound measured
+    ~0ns on v5e silicon; charging launch overhead + a memory roofline
+    made matmul_chain carry 82us of phantom time per step."""
+    text = _module(
+        "  %p0 = bf16[2048,2048]{1,0:T(8,128)(2,1)} parameter(0)\n"
+        '  ROOT %cc = bf16[2048,2048]{1,0:T(8,128)(2,1)} custom-call(%p0), '
+        'custom_call_target="ConcatBitcast"',
+        "p0: bf16[2048,2048]", "bf16[2048,2048]",
+    )
+    cost = _run_entry_op(text, "cc")
+    assert cost.cycles == 0
+    assert cost.hbm_bytes == 0
+
+    # a custom-call with an unknown target still pays the roofline
+    unknown = text.replace("ConcatBitcast", "MyRealKernel")
+    assert _run_entry_op(unknown, "cc").cycles > 0
+
+
+# -- copy pricing -----------------------------------------------------------
+
+_COPY_PARAMS = "p0: bf16[1024,1024]"
+_COPY_RET = "bf16[1024,1024]"
+
+
+def _copy_text(src_layout: str, dst_layout: str) -> str:
+    return _module(
+        f"  %p0 = bf16[1024,1024]{src_layout} parameter(0)\n"
+        f"  ROOT %c = bf16[1024,1024]{dst_layout} copy(%p0)",
+        _COPY_PARAMS, _COPY_RET,
+    )
+
+
+def test_relayout_copy_slower_than_stream_copy():
+    """A copy that changes minor-to-major order is a physical transpose:
+    the conv2d fixture measured 0.42x the plain-copy stream rate."""
+    plain = _run_entry_op(_copy_text(
+        "{1,0:T(8,128)(2,1)}", "{1,0:T(8,128)(2,1)S(1)}"))
+    relayout = _run_entry_op(_copy_text(
+        "{1,0:T(8,128)(2,1)}", "{0,1:T(8,128)(2,1)S(1)}"))
+    assert relayout.cycles > 1.5 * plain.cycles
+    # traffic accounting is unchanged — only the achieved rate drops
+    assert relayout.hbm_bytes == plain.hbm_bytes
+
+
+def test_vmem_to_vmem_copy_runs_at_port_rate():
+    """Same-layout vmem->vmem copies measured 2.4TB/s against the 8.2TB/s
+    banked operand-streaming rate (conv2d %copy.11)."""
+    cfg = SimConfig()
+    vv = _run_entry_op(_copy_text(
+        "{1,0:T(8,128)(2,1)S(1)}", "{1,0:T(8,128)(2,1)S(1)}"), "c", cfg)
+    ideal_vmem_cycles = (
+        2.0 * 1024 * 1024 * 2 / cfg.arch.vmem_bytes_per_cycle
+    )
+    assert vv.mem_cycles > 1.5 * ideal_vmem_cycles
+
+
+# -- reduce model -----------------------------------------------------------
+
+def _reduce_text(dtype: str, dims: str, in_shape: str, out_shape: str,
+                 layout: str) -> str:
+    return _module(
+        f"  %p0 = {dtype}{in_shape}{layout} parameter(0)\n"
+        f"  %init = {dtype}[] constant(0)\n"
+        f"  ROOT %r = {dtype}{out_shape} reduce(%p0, %init), "
+        f"dimensions={{{dims}}}, to_apply=%add",
+        f"p0: {dtype}{in_shape}", f"{dtype}{out_shape}",
+    ).replace(
+        "HloModule m, is_scheduled=true\n",
+        "HloModule m, is_scheduled=true\n\n"
+        "%add (a: f32[], b: f32[]) -> f32[] {\n"
+        "  %a = f32[] parameter(0)\n"
+        "  %b = f32[] parameter(1)\n"
+        "  ROOT %s = f32[] add(%a, %b)\n"
+        "}\n",
+    )
+
+
+def test_reduce_cost_scales_with_dtype_width():
+    """The VPU accumulates packed words: f32 reduce is ~2x bf16 per
+    element (9.2x vs 4.6x elementwise rate on v5e silicon)."""
+    f32 = _run_entry_op(_reduce_text(
+        "f32", "0", "[4096,1024]", "[1024]", "{1,0:T(8,128)}"), "r")
+    bf16 = _run_entry_op(_reduce_text(
+        "bf16", "0", "[4096,1024]", "[1024]", "{1,0:T(8,128)(2,1)}"), "r")
+    assert f32.compute_cycles == pytest.approx(
+        2.0 * bf16.compute_cycles, rel=0.01)
+
+
+def test_minor_dim_reduce_pays_lane_crossing():
+    """Reducing the minor (lane) dimension pays a per-output shuffle tail
+    (decode_step fixture: GEMV-style [.,128]->[.] reduces)."""
+    major = _run_entry_op(_reduce_text(
+        "bf16", "0", "[128,65536]", "[65536]", "{1,0:T(8,128)(2,1)}"), "r")
+    minor = _run_entry_op(_reduce_text(
+        "bf16", "1", "[65536,128]", "[65536]", "{1,0:T(8,128)(2,1)}"), "r")
+    # same element count; the minor-dim variant adds out_elems * tail
+    arch = SimConfig().arch
+    expected_tail = 65536 * arch.vpu_lane_cross_cycles
+    assert minor.compute_cycles - major.compute_cycles == pytest.approx(
+        expected_tail, rel=0.01)
+
+
+# -- movement fusions -------------------------------------------------------
+
+_MOVE_FUSION = """\
+HloModule m, is_scheduled=true
+
+%moved (param_0: bf16[2,1024,1024], param_1: s32[]) -> bf16[1,1024,1024] {
+  %param_0 = bf16[2,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} parameter(0)
+  %param_1 = s32[]{:T(128)} parameter(1)
+  %c0 = s32[]{:T(128)} constant(0)
+  ROOT %ds = bf16[1,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} dynamic-slice(%param_0, %param_1, %c0, %c0), dynamic_slice_sizes={1,1024,1024}
+}
+
+%mixed (param_0: bf16[2,1024,1024], param_1: s32[]) -> bf16[1,1024,1024] {
+  %param_0 = bf16[2,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} parameter(0)
+  %param_1 = s32[]{:T(128)} parameter(1)
+  %c0 = s32[]{:T(128)} constant(0)
+  %ds = bf16[1,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} dynamic-slice(%param_0, %param_1, %c0, %c0), dynamic_slice_sizes={1,1024,1024}
+  ROOT %t = bf16[1,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} tanh(%ds)
+}
+
+ENTRY %main (p0: bf16[2,1024,1024], i: s32[]) -> bf16[1,1024,1024] {
+  %p0 = bf16[2,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} parameter(0)
+  %i = s32[]{:T(128)} parameter(1)
+  %f0 = bf16[1,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} fusion(%p0, %i), kind=kLoop, calls=%moved
+  ROOT %f1 = bf16[1,1024,1024]{2,1,0:T(8,128)(2,1)S(1)} fusion(%p0, %i), kind=kLoop, calls=%mixed
+}
+"""
+
+
+def test_movement_fusion_streams_at_slice_rate():
+    """A fusion containing only data movement (the KV-cache read pattern)
+    streams at DMA slice rate, not banked operand bandwidth; one real
+    compute op inside disables the derate."""
+    mod = parse_hlo_module(_MOVE_FUSION)
+    cfg = SimConfig()
+    cm = CostModel(cfg.arch)
+    entry = mod.entry
+    move = cm.op_cost(entry.op("f0"), entry, mod)
+    mixed = cm.op_cost(entry.op("f1"), entry, mod)
+    assert move.mem_cycles == pytest.approx(
+        mixed.mem_cycles / cfg.arch.vmem_slice_efficiency, rel=0.01)
+
+
+# -- DMA issue latency ------------------------------------------------------
+
+_SMALL_ASYNC_COPY = """\
+HloModule m, is_scheduled=true
+
+ENTRY %main (p0: bf16[4096]) -> bf16[4096] {
+  %p0 = bf16[4096]{0:T(1024)(128)(2,1)} parameter(0)
+  %cs = (bf16[4096]{0:T(1024)(128)(2,1)S(1)}, bf16[4096]{0:T(1024)(128)(2,1)}, u32[]{:S(2)}) copy-start(%p0)
+  ROOT %cd = bf16[4096]{0:T(1024)(128)(2,1)S(1)} copy-done(%cs)
+}
+"""
+
+
+def test_async_dma_start_pays_issue_latency():
+    """An 8KB copy-start measured 1.57us on silicon — pure DMA issue
+    latency, three orders of magnitude above its bandwidth cost.  The
+    exposure appears when the program immediately joins."""
+    mod = parse_hlo_module(_SMALL_ASYNC_COPY)
+    cfg = SimConfig()
+    r = Engine(cfg).run(mod)
+    lat_cycles = cfg.arch.seconds_to_cycles(cfg.arch.dma_issue_latency)
+    assert r.cycles >= lat_cycles
+    no_lat = overlay(cfg, {"arch": {"dma_issue_latency": 0.0}})
+    assert Engine(no_lat).run(mod).cycles < 0.25 * r.cycles
+
+
+def test_dma_issue_latency_overlaps_across_transfers():
+    """Latencies pipeline (many DMA engines): N back-to-back small copies
+    joined at the end cost ~1 latency, not N."""
+    n = 8
+    starts = "\n".join(
+        f"  %cs.{i} = (bf16[4096]{{0:T(1024)(128)(2,1)S(1)}}, "
+        f"bf16[4096]{{0:T(1024)(128)(2,1)}}, u32[]{{:S(2)}}) "
+        f"copy-start(%p0)" for i in range(n)
+    )
+    dones = "\n".join(
+        f"  %cd.{i} = bf16[4096]{{0:T(1024)(128)(2,1)S(1)}} "
+        f"copy-done(%cs.{i})" for i in range(n)
+    )
+    text = (
+        "HloModule m, is_scheduled=true\n\n"
+        "ENTRY %main (p0: bf16[4096]) -> bf16[4096] {\n"
+        "  %p0 = bf16[4096]{0:T(1024)(128)(2,1)} parameter(0)\n"
+        f"{starts}\n{dones}\n"
+        "  ROOT %out = bf16[4096]{0:T(1024)(128)(2,1)S(1)} copy(%p0)\n"
+        "}\n"
+    )
+    mod = parse_hlo_module(text)
+    cfg = SimConfig()
+    r = Engine(cfg).run(mod)
+    lat = cfg.arch.seconds_to_cycles(cfg.arch.dma_issue_latency)
+    assert r.cycles < 2.5 * lat  # not n * lat
+
+
+# -- MXU split choice + sustained efficiency --------------------------------
+
+def test_mxu_splits_rows_when_quantization_hurts():
+    """5 weight passes on 4 MXUs with a huge m: splitting the streamed
+    rows beats sending whole passes (which would round 5/4 up to 2)."""
+    arch = ArchConfig(name="v5e", mxu_count=4)
+    cm = CostModel(arch)
+    m = 50176
+    cycles = cm.mxu_cycles(1, m, 64, 576, "bf16")
+    passes = 5  # ceil(576/128) * ceil(64/128) = 5 * 1
+    old_quantized = 2 * m  # ceil(5/4) serial passes of m rows each
+    assert cycles < 0.75 * old_quantized
+    assert cycles >= passes * (m / 4) / arch.mxu_efficiency
+
+
+def test_mxu_efficiency_derates_sustained_rate():
+    a = ArchConfig()
+    derated = ArchConfig(mxu_efficiency=0.87)
+    big = (1, 4096, 4096, 4096, "bf16")
+    assert CostModel(derated).mxu_cycles(*big) == pytest.approx(
+        CostModel(a).mxu_cycles(*big) / 0.87)

@@ -31,6 +31,7 @@ from tpusim.ir import (
     TensorSpec,
     TraceOp,
     Unit,
+    dtype_bytes,
     leaves_of,
 )
 from tpusim.timing.config import ArchConfig
@@ -64,6 +65,14 @@ DATA_MOVEMENT_OPS = frozenset({
 })
 
 REDUCE_OPS = frozenset({"reduce", "reduce-window", "select-and-scatter"})
+
+#: XLA:TPU internal custom-calls that are aliasing views or compiler
+#: hints — zero device time (all three observed at ~0ns on v5e silicon;
+#: the model was charging launch overhead + a full memory roofline)
+FREE_CUSTOM_CALL_TARGETS = frozenset({
+    "ConcatBitcast", "AllocateBuffer", "AssumeGatherIndicesInBound",
+    "Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+})
 
 #: ops whose cost is set by the moved region, not the full buffers
 _REGION_OPS = frozenset({
@@ -339,6 +348,48 @@ def _fusion_param_region_bytes(
 _CHASE_THROUGH = ("bitcast", "bitcast-convert", "copy", "convert", "reshape")
 
 
+def _is_relayout(src: TensorSpec | None, dst: TensorSpec | None) -> bool:
+    """True when a copy physically rearranges data.  A missing layout
+    annotation means default minor-to-major, so ``None`` must compare
+    equal to the explicit default (and an unannotated tiling must not
+    make a plain copy look like a transpose)."""
+    if src is None or dst is None:
+        return False
+    default = tuple(range(len(src.shape) - 1, -1, -1))
+    src_layout = src.layout if src.layout is not None else default
+    dst_layout = dst.layout if dst.layout is not None else (
+        tuple(range(len(dst.shape) - 1, -1, -1))
+    )
+    if src_layout != dst_layout:
+        return True
+    if src.tiling is None or dst.tiling is None:
+        return False
+    return src.tiling != dst.tiling
+
+
+def _is_movement_fusion(module: ModuleTrace, comp_name: str) -> bool:
+    """True when a fused computation contains only data-movement ops
+    (slice/DUS/concat/copy/...) — it is a DMA-style move, not compute."""
+    if comp_name not in module.computations:
+        return False
+    comp = module.computation(comp_name)
+    cached = getattr(comp, "_is_movement_cache", None)
+    if cached is not None:
+        return cached
+    ok = True
+    for inner in comp.ops:
+        if inner.opcode in FREE_OPCODES or inner.base in FREE_OPCODES:
+            continue
+        if inner.base not in DATA_MOVEMENT_OPS:
+            ok = False
+            break
+    try:
+        comp._is_movement_cache = ok
+    except (AttributeError, TypeError):
+        pass
+    return ok
+
+
 def _fusion_result_region_bytes(called: Computation) -> float | None:
     """If a fusion's outputs are dynamic-update-slices into big carried
     buffers (the activation-stash pattern in scanned training loops), the
@@ -430,6 +481,12 @@ class OpCost:
     vmem_bytes: float = 0.0
     ici_bytes: float = 0.0
     is_async: bool = False
+    #: achieved-rate scale factors per memory port (copies/relayouts/
+    #: movement fusions run below the streaming roofline); every
+    #: mem_cycles computation — including the engine's spill and
+    #: contention repricing — must honor them
+    hbm_rate_scale: float = 1.0
+    vmem_rate_scale: float = 1.0
     #: bytes_accessed from a kernel's own cost estimate (-1 = none)
     est_bytes: float = -1.0
     #: True when a recursion-depth cutoff clipped part of this total —
@@ -483,11 +540,21 @@ class CostModel:
         a = self.arch
         passes = b * math.ceil(k / a.mxu_rows) * math.ceil(n / a.mxu_cols)
         m_pad = max(8, math.ceil(m / 8) * 8)
-        per_pass = max(m_pad, a.mxu_weight_stall_cycles)
-        serial = math.ceil(passes / a.mxu_count)
-        return (
-            serial * per_pass + a.mxu_fill_cycles
-        ) / max(a.mxu_dtype_mult(dtype), 1e-6)
+        # two ways to spread the work over the arrays; XLA picks per shape:
+        # (a) whole passes to different MXUs — best when passes >> count
+        #     and m is small (each MXU loads a fraction of the tiles);
+        # (b) split the streamed rows — every MXU runs all passes on an
+        #     m/count chunk, which avoids the ceil(passes/count)
+        #     quantization that overstated a 5-pass conv on 4 MXUs by 1.6x
+        serial_a = math.ceil(passes / a.mxu_count) * max(
+            m_pad, a.mxu_weight_stall_cycles
+        )
+        m_chunk = max(8, math.ceil(m_pad / a.mxu_count / 8) * 8)
+        serial_b = passes * max(m_chunk, a.mxu_weight_stall_cycles)
+        serial = min(serial_a, serial_b)
+        return (serial + a.mxu_fill_cycles) / max(
+            a.mxu_dtype_mult(dtype) * a.mxu_efficiency, 1e-6
+        )
 
     def _vpu_cycles(self, elem_ops: float, transcendentals: float) -> float:
         a = self.arch
@@ -515,6 +582,15 @@ class CostModel:
         elif base == "convolution":
             b, m, n, k, dt = conv_dims(op, comp)
             c.compute_cycles = self.mxu_cycles(b, m, n, k, dt)
+            w = _parse_window(op.attrs.get("window", ""), 0)
+            if any(s > 1 for s in w["size"]) and not any(
+                d > 1 for d in w["lhs_dilate"]
+            ):
+                # a true spatial conv (not XLA's matmul-as-dilated-conv
+                # encoding) pays the window emitter's im2col overhead
+                c.compute_cycles /= max(
+                    self.arch.mxu_conv_tap_efficiency, 1e-6
+                )
             c.flops = c.mxu_flops = 2.0 * b * m * n * k
             c.unit = Unit.MXU
         elif base == "fusion" and op.called:
@@ -543,10 +619,33 @@ class CostModel:
                 slowdown = 1.0
             else:
                 c.flops = float(in_elems)
-                # full cross-lane reductions run well below elementwise
-                # rate (fit against the reduction fixture)
-                slowdown = self.arch.vpu_reduce_slowdown
-            c.compute_cycles = self._vpu_cycles(c.flops * slowdown, 0)
+                # the VPU accumulates packed words, so the per-element
+                # reduce cost scales with dtype width (v5e silicon:
+                # f32 2D-sum at 9.2x elementwise rate, bf16 row-sum at
+                # 4.6x); reducing the minor (lane) dimension additionally
+                # pays a per-output lane-shuffle tail (decode fixture:
+                # a [.,128]->[.] GEMV-style reduce at ~0.7 cy/output)
+                spec = (
+                    _leaf_shape(comp, op.operands[0]) if op.operands
+                    else op.result if isinstance(op.result, TensorSpec)
+                    else None
+                )
+                dt_scale = (
+                    dtype_bytes(spec.dtype) / 4.0
+                    if spec is not None and spec.dtype else 1.0
+                )
+                slowdown = self.arch.vpu_reduce_slowdown * dt_scale
+                dims = _int_set(op.attrs, "dimensions")
+                if dims and spec is not None:
+                    minor = (
+                        spec.layout[0] if spec.layout
+                        else max(spec.rank - 1, 0)
+                    )
+                    if minor in dims:
+                        c.compute_cycles += (
+                            out_elems * self.arch.vpu_lane_cross_cycles
+                        )
+            c.compute_cycles += self._vpu_cycles(c.flops * slowdown, 0)
             c.unit = Unit.VPU
         elif base == "transpose":
             c.unit = Unit.TRANSPOSE
@@ -578,6 +677,8 @@ class CostModel:
             c.unit = Unit.VPU
         elif base == "custom-call":
             target = op.attrs.get("custom_call_target", "").strip('"')
+            if target in FREE_CUSTOM_CALL_TARGETS:
+                return c
             rate = self.custom_call_flops.get(target)
             est = _parse_cost_estimate(op.attrs.get("backend_config", ""))
             if rate and rate > 0:
@@ -667,6 +768,12 @@ class CostModel:
             return c
         if op.is_async_done or base in ("while", "conditional", "call"):
             return OpCost(unit=Unit.NONE)
+        if (
+            base == "custom-call"
+            and op.attrs.get("custom_call_target", "").strip('"')
+            in FREE_CUSTOM_CALL_TARGETS
+        ):
+            return OpCost(unit=Unit.NONE)
 
         c = self._compute_cost(op, comp, module)
         # roofline over operands + outputs (the standard fusion assumption,
@@ -684,17 +791,35 @@ class CostModel:
             region = _region_bytes(comp, op)
             c.hbm_bytes = min(c.hbm_bytes, region)
             c.vmem_bytes = min(c.vmem_bytes, region)
-        elif base == "copy":
+        if base == "fusion" and op.called and module is not None:
+            if _is_movement_fusion(module, op.called[0]):
+                # a fusion that only slices/concats/copies is a DMA-style
+                # move: its VMEM side streams at port rate, not at the
+                # banked operand-read bandwidth the roofline assumes (the
+                # HBM side already has its own achieved-rate derate)
+                c.vmem_rate_scale = a.vmem_slice_efficiency
+        if base == "copy":
             # a copy moves its payload once; async copy-start results are
             # (src, dst, ctx) tuples, so operand+result charging counts the
             # payload up to 3x.  Cross-port (HBM<->vmem) transfers stream
             # the payload once through each port; same-port copies read and
             # write through the one port (2x payload on it).
-            payload = float(max(
-                (l.nbytes for o in op.operands[:1] if comp.has_op(o)
-                 for l in leaves_of(comp.op(o).result)),
-                default=op.result.nbytes,
-            ))
+            src_leaf = None
+            for o in op.operands[:1]:
+                if comp.has_op(o):
+                    leaves = leaves_of(comp.op(o).result)
+                    if leaves:
+                        # tuple copies: the biggest leaf is the payload
+                        src_leaf = max(leaves, key=lambda l: l.nbytes)
+            dst_leaves = leaves_of(op.result)
+            dst_leaf = (
+                max(dst_leaves, key=lambda l: l.nbytes)
+                if dst_leaves else None
+            )
+            payload = float(
+                src_leaf.nbytes if src_leaf is not None
+                else (dst_leaf.nbytes if dst_leaf is not None else 0)
+            )
             touches_hbm = c.hbm_bytes > 0
             touches_vmem = c.vmem_bytes > 0
             if touches_hbm and touches_vmem:
@@ -703,12 +828,26 @@ class CostModel:
             elif touches_vmem:
                 c.hbm_bytes = 0.0
                 c.vmem_bytes = 2.0 * payload
+                # vmem->vmem copies stream through the load/store ports,
+                # not the full banked operand-read bandwidth
+                c.vmem_rate_scale = a.vmem_copy_efficiency
             else:
                 c.hbm_bytes = 2.0 * payload
                 c.vmem_bytes = 0.0
+            if _is_relayout(src_leaf, dst_leaf):
+                # layout change = physical relayout (tile shuffle), far
+                # below stream rate on both ports (conv2d fixture: 0.42x)
+                c.hbm_rate_scale = min(
+                    c.hbm_rate_scale, a.relayout_efficiency
+                )
+                c.vmem_rate_scale = min(
+                    c.vmem_rate_scale, a.relayout_efficiency
+                )
+        c.hbm_rate_scale = max(c.hbm_rate_scale, 1e-6)
+        c.vmem_rate_scale = max(c.vmem_rate_scale, 1e-6)
         c.mem_cycles = max(
-            c.hbm_bytes / a.hbm_bytes_per_cycle,
-            c.vmem_bytes / a.vmem_bytes_per_cycle,
+            c.hbm_bytes / (a.hbm_bytes_per_cycle * c.hbm_rate_scale),
+            c.vmem_bytes / (a.vmem_bytes_per_cycle * c.vmem_rate_scale),
         )
         c.cycles = a.op_overhead_cycles + max(c.compute_cycles, c.mem_cycles)
         c.is_async = op.is_async_start

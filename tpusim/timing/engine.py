@@ -213,32 +213,135 @@ def _vmem_resident_bytes(module: ModuleTrace) -> float:
     for cname, comp in module.computations.items():
         is_entry = entry_name is not None and cname == entry_name
         for op in comp.ops:
-            if op.opcode in FREE_OPCODES or op.base in FREE_OPCODES:
-                if not (is_entry and op.opcode == "parameter"):
-                    continue
-            if op.base in ("while", "conditional") or op.is_async_done:
-                continue
-            if not is_entry and op.base == "dynamic-update-slice":
-                continue
-            leaves = leaves_of(op.result)
-            if op.is_async_start and op.base == "copy":
-                # result is (dst, src-alias, ctx): only the leading dst
-                # leaf is a new allocation (a vmem->HBM spill copy's S(1)
-                # src alias must not re-count the source buffer)
-                if leaves and leaves[0].memory_space != 0:
-                    total += leaves[0].nbytes
-            elif op.is_async_start:
-                # collective starts carry (operand-alias, result, ...):
-                # one buffer, not the alias pair
-                total += max(
-                    (l.nbytes for l in leaves if l.memory_space != 0),
-                    default=0.0,
-                )
-            else:
-                total += sum(
-                    l.nbytes for l in leaves if l.memory_space != 0
-                )
+            total += _alloc_vmem_bytes(op, is_entry)
     return total
+
+
+def _alloc_vmem_bytes(op: TraceOp, is_entry: bool) -> float:
+    """Vmem (``S(1)``) bytes newly allocated by one op under the alias
+    rules documented on :func:`_vmem_resident_bytes`; 0 for aliases."""
+    if op.opcode in FREE_OPCODES or op.base in FREE_OPCODES:
+        if not (is_entry and op.opcode == "parameter"):
+            return 0.0
+    if op.base in ("while", "conditional") or op.is_async_done:
+        return 0.0
+    if not is_entry and op.base == "dynamic-update-slice":
+        return 0.0
+    leaves = leaves_of(op.result)
+    if op.is_async_start and op.base == "copy":
+        # result is (dst, src-alias, ctx): only the leading dst
+        # leaf is a new allocation (a vmem->HBM spill copy's S(1)
+        # src alias must not re-count the source buffer)
+        if leaves and leaves[0].memory_space != 0:
+            return float(leaves[0].nbytes)
+        return 0.0
+    if op.is_async_start:
+        # collective starts carry (operand-alias, result, ...):
+        # one buffer, not the alias pair
+        return float(max(
+            (l.nbytes for l in leaves if l.memory_space != 0),
+            default=0.0,
+        ))
+    return float(sum(l.nbytes for l in leaves if l.memory_space != 0))
+
+
+def _vmem_peak_live_bytes(module: ModuleTrace) -> float:
+    """Peak *concurrently-live* ``S(1)`` bytes — what the 128MB budget
+    actually constrains.  The conservative sum (above) counts every
+    allocation in the module as if simultaneous; XLA's assignment reuses
+    slots across disjoint lifetimes, so a decode step whose temporaries
+    *sum* to 210MB fits fine (round-4 silicon: the phantom spill priced
+    its 16MB vmem slices at HBM rate, +139%).
+
+    Per computation: parameters' vmem leaves are live throughout (they
+    alias buffers carried in from the caller); local defs become live at
+    their def index and die after their last use (the root lives to the
+    end).  At a while/conditional/call, the callee's peak coexists with
+    the caller's live set at that index — minus the carried operands,
+    which the callee's parameters re-count."""
+    entry_name = module.entry_name
+
+    def comp_peak(cname: str, depth: int) -> float:
+        comp = module.computations.get(cname)
+        if comp is None or depth > 16:
+            return 0.0
+        cached = getattr(comp, "_peak_live_cache_c", None)
+        if cached is not None:
+            return cached
+        is_entry = entry_name is not None and cname == entry_name
+        n = len(comp.ops)
+        last_use: dict[str, int] = {}
+        for i, op in enumerate(comp.ops):
+            for o in op.operands:
+                last_use[o] = max(last_use.get(o, i), i)
+        # extend lifetimes through aliasing consumers (gte/bitcast/tuple,
+        # *-done, while/conditional/call results): the underlying buffer
+        # lives until the alias's own last use.  Reverse order: an
+        # alias's extended lifetime is final before its operands are
+        # visited.
+        ext: dict[str, int] = {}
+        for i in range(n - 1, -1, -1):
+            op = comp.ops[i]
+            is_alias = (
+                op.opcode in FREE_OPCODES or op.base in FREE_OPCODES
+                or op.is_async_done
+                or op.base in ("while", "conditional", "call")
+            )
+            if not is_alias:
+                continue
+            eff = max(last_use.get(op.name, i), ext.get(op.name, i))
+            for o in op.operands:
+                ext[o] = max(ext.get(o, 0), eff)
+        frees: dict[int, float] = defaultdict(float)
+        live = 0.0
+        local_peak = 0.0
+        for i, op in enumerate(comp.ops):
+            if op.base in ("while", "conditional", "call") and op.called:
+                # callee temporaries coexist with everything live here;
+                # subtract the carried S(1) operands the callee's params
+                # re-count
+                carried = sum(
+                    l.nbytes
+                    for o in op.operands if comp.has_op(o)
+                    for l in leaves_of(comp.op(o).result)
+                    if l.memory_space != 0
+                )
+                inner = max(
+                    comp_peak(callee, depth + 1) for callee in op.called
+                )
+                local_peak = max(
+                    local_peak, live + max(inner - carried, 0.0)
+                )
+            nbytes = (
+                float(sum(
+                    l.nbytes for l in leaves_of(op.result)
+                    if l.memory_space != 0
+                ))
+                if op.opcode == "parameter" and not is_entry
+                else _alloc_vmem_bytes(op, is_entry)
+            )
+            if nbytes > 0:
+                live += nbytes
+                if live > local_peak:
+                    local_peak = live
+                if op.opcode == "parameter" and not is_entry:
+                    die = n  # carried state stays live for the body
+                else:
+                    die = max(last_use.get(op.name, n), ext.get(op.name, 0))
+                frees[die] += nbytes
+            live -= frees.pop(i, 0.0)
+        try:
+            comp._peak_live_cache_c = local_peak
+        except (AttributeError, TypeError):
+            pass
+        return local_peak
+
+    if entry_name is not None and entry_name in module.computations:
+        return comp_peak(entry_name, 0)
+    return max(
+        (comp_peak(cname, 0) for cname in list(module.computations)),
+        default=0.0,
+    )
 
 
 def _residency_of(module: ModuleTrace) -> float:
@@ -277,6 +380,18 @@ class Engine:
         self.record_timeline = record_timeline
         self.max_timeline_events = max_timeline_events
 
+    @staticmethod
+    def _peak_live_of(module: ModuleTrace) -> float:
+        cached = getattr(module, "_peak_live_cache", None)
+        if cached is not None:
+            return cached
+        peak = _vmem_peak_live_bytes(module)
+        try:
+            module._peak_live_cache = peak
+        except (AttributeError, TypeError):
+            pass
+        return peak
+
     def _topology_for(self, module: ModuleTrace) -> Topology:
         if self.topology is not None:
             return self.topology
@@ -292,8 +407,17 @@ class Engine:
         spill_frac = 1.0
         if self.config.model_vmem_capacity:
             resident = _residency_of(module)
-            result.vmem_resident_bytes = resident
             cap = float(self.arch.vmem_bytes)
+            if resident > cap > 0:
+                # the conservative sum counts every allocation as
+                # simultaneous; before pricing a spill, check what is
+                # actually concurrently live (XLA reuses slots across
+                # disjoint lifetimes — a decode step whose temporaries sum
+                # to 210MB fits the 128MB budget fine).  The liveness walk
+                # needs a full parse, so it only runs when the cheap bound
+                # says the budget might be blown.
+                resident = self._peak_live_of(module)
+            result.vmem_resident_bytes = resident
             if resident > cap > 0:
                 # over-subscribed vmem: only cap/resident of the pinned
                 # bytes can actually live on-chip; the rest spills to HBM
@@ -440,8 +564,9 @@ class Engine:
                 cost.hbm_bytes += spilled
                 result.vmem_spill_bytes += spilled
                 cost.mem_cycles = max(
-                    cost.hbm_bytes / hbm_bpc,
-                    cost.vmem_bytes / a.vmem_bytes_per_cycle,
+                    cost.hbm_bytes / (hbm_bpc * cost.hbm_rate_scale),
+                    cost.vmem_bytes
+                    / (a.vmem_bytes_per_cycle * cost.vmem_rate_scale),
                 )
                 cost.cycles = a.op_overhead_cycles + max(
                     cost.compute_cycles, cost.mem_cycles
@@ -479,7 +604,13 @@ class Engine:
             if op.is_async_start:
                 dur = cost.cycles
                 start = max(t, dma_free)
-                pending[op.name] = start + dur
+                # issue latency (descriptor setup + first byte) delays the
+                # completion but does not occupy the channel: TPUs run many
+                # DMA engines, so back-to-back small transfers pipeline
+                # their latencies (lstm fixture: 8KB loop copies at 1.57us
+                # each, pure latency) while payloads serialize on bandwidth
+                lat = a.seconds_to_cycles(a.dma_issue_latency)
+                pending[op.name] = start + lat + dur
                 dma_names.add(op.name)
                 dma_free = start + dur
                 if cost.hbm_bytes > 0:
@@ -504,9 +635,14 @@ class Engine:
                 q_bytes = (dma_busy_until - t) * hbm_bpc
                 shared = min(cost.hbm_bytes, q_bytes)
                 penalty = shared / hbm_bpc
-                hbm_time = cost.hbm_bytes / hbm_bpc + penalty
+                hbm_time = (
+                    cost.hbm_bytes / (hbm_bpc * cost.hbm_rate_scale)
+                    + penalty
+                )
                 mem_cycles = max(
-                    hbm_time, cost.vmem_bytes / a.vmem_bytes_per_cycle
+                    hbm_time,
+                    cost.vmem_bytes
+                    / (a.vmem_bytes_per_cycle * cost.vmem_rate_scale),
                 )
                 new_dur = a.op_overhead_cycles + max(
                     cost.compute_cycles, mem_cycles

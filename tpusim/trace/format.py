@@ -186,6 +186,23 @@ def save_trace(
     return TraceDir(path=path, meta=meta)
 
 
+def select_module(pod: "PodTrace", want: str | None):
+    """The ONE policy for resolving a manifest entry to a module: the
+    named module when ``want`` is given, the sole module otherwise, and a
+    hard error on ambiguity.  Shared by bench replay, the refiner, and
+    correlation so they can never silently disagree about which program
+    a fixture measures."""
+    if want is not None:
+        return pod.modules[want]
+    if len(pod.modules) == 1:
+        return next(iter(pod.modules.values()))
+    raise ValueError(
+        f"trace has {len(pod.modules)} modules "
+        f"({sorted(pod.modules)}); manifest entry must name one via "
+        f"'module'"
+    )
+
+
 def load_trace(path: str | Path) -> PodTrace:
     """Load a trace directory into a :class:`PodTrace` (modules parsed)."""
     path = Path(path)
