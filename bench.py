@@ -58,6 +58,11 @@ SUITE = [
     ("decode_step",
      {"batch": 8, "seq_cache": 1024, "heads": 8, "head_dim": 128,
       "layers": 2, "pos": 512}, 16),
+    # mechanism-isolating ubenches (round-4 calibration): narrow-minor-dim
+    # VPU lane occupancy and relayouting-copy pricing get their own
+    # silicon truth instead of hiding inside mixed workloads
+    ("softmax_narrow", {"batch": 8, "seq": 1024, "heads": 8}, 32),
+    ("relayout_copy", {"rows": 4096, "cols": 4096}, 32),
 ]
 
 ATTEMPTS = int(os.environ.get("TPUSIM_BENCH_ATTEMPTS", "3"))
@@ -193,12 +198,14 @@ def child_main() -> int:
     # the committed overlay improves on the seed by construction (round-4
     # fix — a jointly-worse single-knob fit shipped and was rejected by
     # the validation below; the refiner makes acceptance the normal case)
+    refine_seed_text = None
     if tuned_info and fixture_entries:
         try:
             from tpusim.harness.refine import refine_arch_on_fixtures
             from tpusim.timing.arch import detect_arch
 
             overlay_path = REPO_ROOT / tuned_info["overlay"]
+            refine_seed_text = overlay_path.read_text()
             rr = refine_arch_on_fixtures(
                 detect_arch(dev.device_kind).name,
                 fixture_entries, FIXTURE_DIR,
@@ -316,6 +323,26 @@ def child_main() -> int:
             log(f"bench: overlay self-validation FAILED: "
                 f"{type(e).__name__}: {e}")
 
+    if (
+        tuned_info is not None
+        and tuned_info.get("refined")
+        and headline_rows is None
+        and not tuned_info.get("rejected")
+        and refine_seed_text is not None
+    ):
+        # the refiner rewrote the overlay but the self-validation never
+        # confirmed it (skipped or raised): an unvalidated fit must not
+        # become the committed config while the headline reflects the
+        # seed — restore the seed overlay so artifact and number agree
+        try:
+            (REPO_ROOT / tuned_info["overlay"]).write_text(refine_seed_text)
+            tuned_info["refined"]["reverted"] = "validation did not run"
+            log("bench: refined overlay REVERTED to seed "
+                "(self-validation did not confirm it)")
+        except Exception as e:
+            log(f"bench: refined-overlay revert FAILED: "
+                f"{type(e).__name__}: {e}")
+
     if save_fixtures and fixture_entries:
         try:
             from tpusim.timing.arch import detect_arch
@@ -406,6 +433,7 @@ def child_main() -> int:
         try:
             from tpusim.harness.correl_ops import (
                 correlate_counters, correlate_ops, write_correl_ops,
+                xla_op_estimates,
             )
 
             # assembled from the SAME device traces that produced the
@@ -417,6 +445,10 @@ def child_main() -> int:
                         prof["engine_result"], prof["ops"],
                         clock_hz=prof["clock_hz"], workload=name,
                         real_iters=prof["iters"],
+                        xla_estimates=(
+                            xla_op_estimates(prof["module"])
+                            if prof.get("module") is not None else None
+                        ),
                     )
                     corr.counters = correlate_counters(
                         prof["engine_result"], prof["ops"],

@@ -250,6 +250,20 @@ def test_mxu_splits_rows_when_quantization_hurts():
     assert cycles >= passes * (m / 4) / arch.mxu_efficiency
 
 
+def test_narrow_minor_dim_strands_vpu_lanes():
+    """An elementwise op whose minor dim is 8 uses 8 of 128 lanes — the
+    decode fixture's [8,1024,8] softmax stages run ~16x below bulk rate."""
+    cm = CostModel(SimConfig().arch)
+    from tpusim.ir import TensorSpec
+
+    bulk = cm._vpu_util(TensorSpec("bf16", (8, 1024, 128), (2, 1, 0)))
+    narrow = cm._vpu_util(TensorSpec("bf16", (8, 1024, 8), (0, 2, 1)))
+    assert bulk == 1.0
+    assert narrow == pytest.approx((8 / 128) * 1.0)
+    # rank-1 vectors span lanes fully
+    assert cm._vpu_util(TensorSpec("f32", (4096,), (0,))) == 1.0
+
+
 def test_mxu_efficiency_derates_sustained_rate():
     a = ArchConfig()
     derated = ArchConfig(mxu_efficiency=0.87)

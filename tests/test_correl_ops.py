@@ -339,10 +339,10 @@ def test_load_known_outliers_reads_committed_config():
 
     outliers = load_known_outliers()
     assert isinstance(outliers, list)
-    # the committed config carries the wall-sourced-truth deviation
-    assert any(
-        o.get("workload") == "elementwise_stream" for o in outliers
-    )
+    # the list is currently EMPTY by design: the one entry it carried
+    # (wall-sourced elementwise truth) resolved when the live run
+    # refreshed every fixture with device-sourced times.  The loader and
+    # matcher machinery stay exercised by the synthetic tests above.
 
 
 def test_known_outlier_edge_cases(tmp_path):

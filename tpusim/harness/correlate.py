@@ -191,7 +191,7 @@ def correlate_workload(
                 op_profile_out.update(
                     ops=t["ops"], engine_result=res,
                     clock_hz=cfg.arch.clock_hz, arch=cfg.arch,
-                    iters=iters,
+                    iters=iters, module=cap.module,
                 )
         except Exception as e:
             import sys

@@ -341,3 +341,50 @@ def build_lstm_layer(batch: int, hidden: int, seq: int, dtype: str):
         return hs
 
     return f, (xs, w, u, b)
+
+
+@register(
+    "softmax_narrow",
+    description="softmax over a NARROW minor dim (8 in the 128-lane "
+    "position) — validates the VPU lane-occupancy model the decode "
+    "fixture exposed (round-4 calibration #12)",
+    suite="ubench",
+    batch=8, seq=1024, heads=8,
+)
+def build_softmax_narrow(batch: int, seq: int, heads: int):
+    import jax
+    import jax.numpy as jnp
+
+    # [batch, seq, heads] with heads minor: softmax over seq keeps the
+    # tiny heads dim in the lane position, stranding 120 of 128 lanes
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (batch, seq, heads), jnp.bfloat16
+    )
+
+    def f(x):
+        return jax.nn.softmax(x.astype(jnp.float32), axis=1).astype(x.dtype)
+
+    return f, (x,)
+
+
+@register(
+    "relayout_copy",
+    description="layout-changing device copy (transposed output layout) — "
+    "validates the relayout-vs-stream copy pricing (round-4 "
+    "calibration #6)",
+    suite="ubench",
+    rows=4096, cols=4096,
+)
+def build_relayout_copy(rows: int, cols: int):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16
+    )
+
+    def f(x):
+        # a physical transpose: XLA emits a relayouting copy on TPU
+        return x.T + jnp.bfloat16(1.0)
+
+    return f, (x,)

@@ -284,6 +284,14 @@ def while_trip_count(op: TraceOp, default: int = 1) -> int:
     return default
 
 
+def _result_leaf(op: TraceOp) -> TensorSpec | None:
+    """Largest leaf of an op's result (the shape a VPU op iterates)."""
+    leaves = leaves_of(op.result)
+    if not leaves:
+        return None
+    return max(leaves, key=lambda l: l.nbytes)
+
+
 def _leaf_shape(comp: Computation, operand: str) -> TensorSpec:
     """Resolve an operand name to its (first leaf) TensorSpec."""
     if comp.has_op(operand):
@@ -556,12 +564,39 @@ class CostModel:
             a.mxu_dtype_mult(dtype) * a.mxu_efficiency, 1e-6
         )
 
-    def _vpu_cycles(self, elem_ops: float, transcendentals: float) -> float:
+    def _vpu_cycles(
+        self, elem_ops: float, transcendentals: float, util: float = 1.0,
+    ) -> float:
         a = self.arch
+        util = max(util, 1e-3)
         return (
-            elem_ops / a.vpu_flops_per_cycle
-            + transcendentals / a.vpu_transcendental_per_cycle
+            elem_ops / (a.vpu_flops_per_cycle * util)
+            + transcendentals / (a.vpu_transcendental_per_cycle * util)
         )
+
+    def _vpu_util(self, spec: TensorSpec | None) -> float:
+        """Lane/sublane occupancy of a VPU op on this operand/result shape.
+
+        The (8,128) vector registers map the two minor-most dims to
+        (sublane, lane); a narrow minor dim strands lanes — decode's
+        [8,1024,8] softmax stages run at ~1/16 throughput on silicon
+        because dim 8 sits in the 128-lane position.  Bulk shapes
+        (minor >= 128) are unaffected."""
+        if spec is None or not spec.shape:
+            return 1.0
+        order = (
+            spec.layout if spec.layout is not None
+            else tuple(range(spec.rank - 1, -1, -1))
+        )
+        if not order:
+            return 1.0
+        lanes = float(self.arch.vpu_lanes)
+        subl = float(self.arch.vpu_sublanes)
+        minor = spec.shape[order[0]] if order[0] < spec.rank else 1
+        util = min(1.0, minor / lanes)
+        if len(order) > 1 and order[1] < spec.rank:
+            util *= min(1.0, spec.shape[order[1]] / subl)
+        return util
 
     # -- per-op compute cost (no memory term) ------------------------------
 
@@ -600,11 +635,15 @@ class CostModel:
         elif base in TRANSCENDENTAL_OPS:
             c.transcendentals = float(out_elems)
             c.flops = float(out_elems)
-            c.compute_cycles = self._vpu_cycles(0, c.transcendentals)
+            c.compute_cycles = self._vpu_cycles(
+                0, c.transcendentals, self._vpu_util(_result_leaf(op)),
+            )
             c.unit = Unit.VPU
         elif base in ELEMENTWISE_OPS:
             c.flops = float(out_elems)
-            c.compute_cycles = self._vpu_cycles(c.flops, 0)
+            c.compute_cycles = self._vpu_cycles(
+                c.flops, 0, self._vpu_util(_result_leaf(op)),
+            )
             c.unit = Unit.VPU
         elif base in REDUCE_OPS:
             in_elems = sum(
@@ -645,7 +684,10 @@ class CostModel:
                         c.compute_cycles += (
                             out_elems * self.arch.vpu_lane_cross_cycles
                         )
-            c.compute_cycles += self._vpu_cycles(c.flops * slowdown, 0)
+            util = self._vpu_util(
+                _leaf_shape(comp, op.operands[0]) if op.operands else None
+            )
+            c.compute_cycles += self._vpu_cycles(c.flops * slowdown, 0, util)
             c.unit = Unit.VPU
         elif base == "transpose":
             c.unit = Unit.TRANSPOSE

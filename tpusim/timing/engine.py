@@ -286,6 +286,9 @@ def _vmem_peak_live_bytes(module: ModuleTrace) -> float:
                 op.opcode in FREE_OPCODES or op.base in FREE_OPCODES
                 or op.is_async_done
                 or op.base in ("while", "conditional", "call")
+                # non-entry DUS updates its source in place: the source
+                # must stay live until the DUS *result*'s last use
+                or (not is_entry and op.base == "dynamic-update-slice")
             )
             if not is_alias:
                 continue
@@ -620,7 +623,12 @@ class Engine:
                 result.opcode_cycles[base] += dur
                 result.hbm_bytes += cost.hbm_bytes
                 result.per_op_hbm_bytes[op.name] += cost.hbm_bytes
-                self._emit(result, op, start, start + dur, Unit.DMA)
+                # emit the EXPOSURE (queueing + latency + transfer): the
+                # device's async-op events span issue to completion, so
+                # per-op correlation must compare like with like — the
+                # span opens at issue time t, not at channel-free time
+                # (the channel-occupancy accounting above still uses dur)
+                self._emit(result, op, t, start + lat + dur, Unit.DMA)
                 t += a.op_overhead_cycles
                 result.op_count += 1
                 continue
