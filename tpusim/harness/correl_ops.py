@@ -467,8 +467,11 @@ def correlate_ops(
             real_ns=sil.avg_ns,
             sim_count=count,
             real_count=sil.count / max(real_iters, 1),
-            is_async=(
-                key.split(".")[0].endswith("-start") or opcode == "async"
+            is_async=bool(
+                getattr(result, "per_op_async", {}).get(name)
+                # fallback for results without the exact flag
+                or key.split(".")[0].endswith("-start")
+                or opcode == "async"
             ),
             xla_cycles=(xla_estimates or {}).get(name),
         ))

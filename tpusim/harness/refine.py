@@ -165,7 +165,9 @@ def refine(
     vector to the objective (fixture-replay mean |error|, percent).
     Each sweep probes every knob at ±rel_step (shrinking steps across
     sweeps) and keeps strict improvements; stops early when a full sweep
-    improves by less than ``min_gain`` percentage points."""
+    at the FINEST step improves by less than ``min_gain`` percentage
+    points (a no-gain coarse sweep still advances to finer steps — a
+    coarse probe overshooting a nearby optimum must not end the search)."""
     knobs = dict(knobs or KNOBS)
     cur = {k: float(base_values[k]) for k in knobs if k in base_values}
     evals = 0
@@ -196,7 +198,7 @@ def refine(
                 err = _eval(cand)
                 if err < best:
                     best, cur = err, cand
-        if sweep_start - best < min_gain:
+        if sweep_start - best < min_gain and step == rel_steps[-1]:
             break
     changed = {
         k: v for k, v in cur.items()

@@ -592,8 +592,9 @@ class CostModel:
             return 1.0
         lanes = float(self.arch.vpu_lanes)
         subl = float(self.arch.vpu_sublanes)
-        minor = spec.shape[order[0]] if order[0] < spec.rank else 1
-        util = min(1.0, minor / lanes)
+        if order[0] >= spec.rank:
+            return 1.0  # malformed layout: stay neutral, don't penalize
+        util = min(1.0, spec.shape[order[0]] / lanes)
         if len(order) > 1 and order[1] < spec.rank:
             util *= min(1.0, spec.shape[order[1]] / subl)
         return util

@@ -91,6 +91,9 @@ class EngineResult:
         default_factory=lambda: defaultdict(float)
     )
     per_op_opcode: dict[str, str] = field(default_factory=dict)
+    #: instruction names that are async transfer/collective starts — the
+    #: exact flag per-op correlation needs (name conventions lie)
+    per_op_async: dict[str, bool] = field(default_factory=dict)
     # per-instruction traffic/work (the counter substrate for the
     # counter-level silicon cross-check: achieved GB/s and TFLOP/s per op)
     per_op_hbm_bytes: dict[str, float] = field(
@@ -157,6 +160,7 @@ class EngineResult:
         for k, v in other.per_op_mxu_flops.items():
             self.per_op_mxu_flops[k] += v * times
         self.per_op_opcode.update(other.per_op_opcode)
+        self.per_op_async.update(other.per_op_async)
 
     def stats_dict(self) -> dict[str, float]:
         d = {
@@ -623,12 +627,14 @@ class Engine:
                 result.opcode_cycles[base] += dur
                 result.hbm_bytes += cost.hbm_bytes
                 result.per_op_hbm_bytes[op.name] += cost.hbm_bytes
-                # emit the EXPOSURE (queueing + latency + transfer): the
-                # device's async-op events span issue to completion, so
-                # per-op correlation must compare like with like — the
-                # span opens at issue time t, not at channel-free time
-                # (the channel-occupancy accounting above still uses dur)
-                self._emit(result, op, t, start + lat + dur, Unit.DMA)
+                # per-op correlation sees the EXPOSURE (queueing +
+                # latency + transfer — the device's async events span
+                # issue to completion); the timeline keeps the channel
+                # occupancy span
+                self._emit(
+                    result, op, start, start + dur, Unit.DMA,
+                    per_op_span=(t, start + lat + dur),
+                )
                 t += a.op_overhead_cycles
                 result.op_count += 1
                 continue
@@ -706,12 +712,19 @@ class Engine:
     def _emit(
         self, result: EngineResult, op: TraceOp, start: float, end: float,
         unit: Unit,
+        per_op_span: tuple[float, float] | None = None,
     ) -> None:
         # per-instruction aggregates are always recorded (cheap dict adds;
-        # per-op correlation needs them even without the full timeline)
-        result.per_op_cycles[op.name] += end - start
+        # per-op correlation needs them even without the full timeline).
+        # ``per_op_span`` lets async transfers report their EXPOSURE
+        # (issue->completion) to correlation while the timeline keeps the
+        # channel-occupancy span — two consumers, two observables.
+        po_start, po_end = per_op_span if per_op_span else (start, end)
+        result.per_op_cycles[op.name] += po_end - po_start
         result.per_op_count[op.name] += 1.0
         result.per_op_opcode.setdefault(op.name, op.base)
+        if op.is_async_start:
+            result.per_op_async[op.name] = True
         if not self.record_timeline:
             return
         if len(result.timeline) >= self.max_timeline_events:
