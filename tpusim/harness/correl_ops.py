@@ -139,6 +139,28 @@ class OpCorrelation:
             den += w
         return num / den if den else math.inf
 
+    def async_aggregate(self) -> dict[str, float] | None:
+        """Summed exposures over the async rows — the only grain at
+        which FIFO-serialized sim exposure and concurrent-sharing device
+        occupancy are comparable (each double-counts shared time the
+        same way only in total)."""
+        sim = real = 0.0
+        n = 0
+        for r in self.rows:
+            if not r.is_async or r.real_ns <= 0:
+                continue
+            sim += r.sim_ns * r.real_count
+            real += r.real_ns * r.real_count
+            n += 1
+        if n == 0 or real <= 0:
+            return None
+        return {
+            "ops": n,
+            "sim_exposure_ns": round(sim, 1),
+            "real_exposure_ns": round(real, 1),
+            "error_pct": round(100.0 * (sim - real) / real, 2),
+        }
+
     def worst(self, n: int = 10) -> list[OpRow]:
         """Top-N mispredictions by absolute time delta (the outlier list of
         ``plot-correlation.py``)."""
@@ -180,6 +202,8 @@ class OpCorrelation:
             "by_opcode": self.by_opcode(),
             "sim_only": self.sim_only[:20],
             "silicon_only": self.silicon_only[:20],
+            **({"async_aggregate": agg}
+               if (agg := self.async_aggregate()) is not None else {}),
             **({"counters": self.counters} if self.counters else {}),
             "rows": [r.to_json() for r in self.rows],
         }

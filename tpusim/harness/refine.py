@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable
 
-__all__ = ["RefineResult", "KNOBS", "refine", "replay_mean_abs_err"]
+__all__ = ["RefineResult", "KNOBS", "refine", "refine_arch_on_fixtures"]
 
 #: knob name -> (bounds lo, hi).  Names are ArchConfig fields; values
 #: outside the bounds are physically implausible and rejected even if
@@ -78,18 +78,6 @@ class RefineResult:
             else:
                 lines.append(f"-arch.{name} {val:.4g}")
         return lines
-
-
-def replay_mean_abs_err(
-    engine_factory: Callable[[dict[str, Any]], Any],
-    replay: Callable[[Any], list[float]],
-    arch_updates: dict[str, Any],
-) -> float:
-    """Mean |signed error %| of one replay under an arch overlay."""
-    errs = replay(engine_factory(arch_updates))
-    if not errs:
-        return math.inf
-    return sum(abs(e) for e in errs) / len(errs)
 
 
 def refine_arch_on_fixtures(

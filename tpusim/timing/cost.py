@@ -284,6 +284,16 @@ def while_trip_count(op: TraceOp, default: int = 1) -> int:
     return default
 
 
+def _is_free_custom_call(op: TraceOp) -> bool:
+    """XLA:TPU marker custom-calls (aliasing views / compiler hints) —
+    zero device time, no memory traffic."""
+    return (
+        op.base == "custom-call"
+        and op.attrs.get("custom_call_target", "").strip('"')
+        in FREE_CUSTOM_CALL_TARGETS
+    )
+
+
 def _result_leaf(op: TraceOp) -> TensorSpec | None:
     """Largest leaf of an op's result (the shape a VPU op iterates)."""
     leaves = leaves_of(op.result)
@@ -719,9 +729,9 @@ class CostModel:
             c.compute_cycles = self._vpu_cycles(c.flops, 0)
             c.unit = Unit.VPU
         elif base == "custom-call":
-            target = op.attrs.get("custom_call_target", "").strip('"')
-            if target in FREE_CUSTOM_CALL_TARGETS:
+            if _is_free_custom_call(op):
                 return c
+            target = op.attrs.get("custom_call_target", "").strip('"')
             rate = self.custom_call_flops.get(target)
             est = _parse_cost_estimate(op.attrs.get("backend_config", ""))
             if rate and rate > 0:
@@ -811,11 +821,7 @@ class CostModel:
             return c
         if op.is_async_done or base in ("while", "conditional", "call"):
             return OpCost(unit=Unit.NONE)
-        if (
-            base == "custom-call"
-            and op.attrs.get("custom_call_target", "").strip('"')
-            in FREE_CUSTOM_CALL_TARGETS
-        ):
+        if _is_free_custom_call(op):
             return OpCost(unit=Unit.NONE)
 
         c = self._compute_cost(op, comp, module)

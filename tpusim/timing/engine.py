@@ -462,6 +462,7 @@ class Engine:
         # queue's remaining bytes at time t are (horizon - t) * bandwidth
         dma_busy_until = t0
         hbm_bpc = a.hbm_bytes_per_cycle
+        dma_lat = a.seconds_to_cycles(a.dma_issue_latency)
         contend = self.config.model_hbm_contention
         overlap = self.config.overlap_collectives
         # op-granularity checkpoint/resume applies to the entry walk only
@@ -616,8 +617,7 @@ class Engine:
                 # DMA engines, so back-to-back small transfers pipeline
                 # their latencies (lstm fixture: 8KB loop copies at 1.57us
                 # each, pure latency) while payloads serialize on bandwidth
-                lat = a.seconds_to_cycles(a.dma_issue_latency)
-                pending[op.name] = start + lat + dur
+                pending[op.name] = start + dma_lat + dur
                 dma_names.add(op.name)
                 dma_free = start + dur
                 if cost.hbm_bytes > 0:
@@ -633,7 +633,7 @@ class Engine:
                 # occupancy span
                 self._emit(
                     result, op, start, start + dur, Unit.DMA,
-                    per_op_span=(t, start + lat + dur),
+                    per_op_span=(t, start + dma_lat + dur),
                 )
                 t += a.op_overhead_cycles
                 result.op_count += 1
