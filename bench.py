@@ -67,10 +67,10 @@ SUITE = [
 
 ATTEMPTS = int(os.environ.get("TPUSIM_BENCH_ATTEMPTS", "3"))
 BACKOFF_S = (0, 30, 90)
-# the child now also runs the tuner fits and per-workload device-time
-# profiling before the correlation suite; 1500s was sized for the suite
-# alone (round-3 shape)
-CHILD_TIMEOUT_S = int(os.environ.get("TPUSIM_BENCH_TIMEOUT", "2100"))
+# the child runs the tuner fits, per-workload device-time profiling, the
+# replay refiner, and the 12-workload correlation suite; sized for a
+# cold XLA compile of every program (first compile ~20-40s each)
+CHILD_TIMEOUT_S = int(os.environ.get("TPUSIM_BENCH_TIMEOUT", "2400"))
 
 
 def log(msg: str) -> None:

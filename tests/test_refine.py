@@ -90,6 +90,24 @@ def test_overlay_lines_roundtrip_through_flag_parser():
     not (SILICON / "manifest.json").exists(),
     reason="no committed silicon fixtures",
 )
+def test_refine_cli_writes_overlay(tmp_path):
+    from tpusim.__main__ import main
+
+    out = tmp_path / "refined.flags"
+    rc = main([
+        "refine", "--fixtures", str(SILICON), "--sweeps", "1",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    text = out.read_text()
+    assert text.startswith("# tpusim replay-refined fit")
+    assert "-arch." in text
+
+
+@pytest.mark.skipif(
+    not (SILICON / "manifest.json").exists(),
+    reason="no committed silicon fixtures",
+)
 def test_refine_on_committed_fixtures_improves_or_holds():
     """End-to-end on the real committed fixtures: a short descent from
     the raw preset must improve the replay objective (the committed

@@ -258,6 +258,42 @@ def _cmd_tune(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_refine(args: argparse.Namespace) -> int:
+    """Offline replay refinement against the committed silicon fixtures
+    — no chip needed (``tune`` is the on-chip microbench pass; this is
+    the joint fit on the objective bench reports)."""
+    from pathlib import Path
+
+    from tpusim.harness.refine import refine_arch_on_fixtures
+
+    fixture_dir = Path(args.fixtures)
+    manifest_path = fixture_dir / "manifest.json"
+    if not manifest_path.exists():
+        print(f"no fixture manifest at {manifest_path}", file=sys.stderr)
+        return 1
+    manifest = json.loads(manifest_path.read_text())
+    arch = args.arch or manifest.get("arch", "v5e")
+    seed = [args.seed] if args.seed else []
+    result = refine_arch_on_fixtures(
+        arch, manifest.get("workloads", []), fixture_dir,
+        base_overlays=seed, max_sweeps=args.sweeps,
+    )
+    print(f"fixture replay: {result.start_err_pct:.2f}% -> "
+          f"{result.final_err_pct:.2f}% mean |error| "
+          f"({result.evals} evals, {result.sweeps} sweeps)")
+    for k, v in sorted(result.changed.items()):
+        print(f"  {k} -> {v:.6g}")
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            "\n".join(result.overlay_lines(manifest.get(
+                "device_kind", ""))) + "\n"
+        )
+        print(f"overlay written to {out}")
+    return 0
+
+
 def _cmd_bbv(args: argparse.Namespace) -> int:
     from tpusim.tools.bbv import compute_bbv, write_simpoint_bb
     from tpusim.trace.format import load_trace
@@ -387,6 +423,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="fit power coefficients instead (telemetry when "
                          "available, anchor fixtures otherwise)")
     pt.set_defaults(fn=_cmd_tune)
+
+    pf = sub.add_parser(
+        "refine",
+        help="joint-fit arch knobs against committed silicon fixtures "
+             "(offline; coordinate descent on the replay objective)",
+    )
+    pf.add_argument(
+        "--fixtures", default="reports/silicon",
+        help="fixture dir with manifest.json (default: reports/silicon)",
+    )
+    pf.add_argument("--arch", default=None)
+    pf.add_argument("--seed", default=None,
+                    help="overlay flag file to seed the search from")
+    pf.add_argument("--sweeps", type=int, default=6)
+    pf.add_argument("--out", default=None,
+                    help="write the refined overlay here")
+    pf.set_defaults(fn=_cmd_refine)
 
     pw = sub.add_parser("workloads", help="list registered workloads")
     pw.set_defaults(fn=_cmd_workloads)
