@@ -262,7 +262,7 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     """Offline replay refinement against the committed silicon fixtures
     — no chip needed (``tune`` is the on-chip microbench pass; this is
     the joint fit on the objective bench reports)."""
-    from pathlib import Path
+    import math
 
     from tpusim.harness.refine import refine_arch_on_fixtures
 
@@ -278,6 +278,14 @@ def _cmd_refine(args: argparse.Namespace) -> int:
         arch, manifest.get("workloads", []), fixture_dir,
         base_overlays=seed, max_sweeps=args.sweeps,
     )
+    if not math.isfinite(result.start_err_pct):
+        # no fixture replayed: an "overlay" of untouched preset values
+        # must not masquerade as a fit
+        print(
+            f"no fixture workload replayed from {fixture_dir}; "
+            f"nothing to refine", file=sys.stderr,
+        )
+        return 1
     print(f"fixture replay: {result.start_err_pct:.2f}% -> "
           f"{result.final_err_pct:.2f}% mean |error| "
           f"({result.evals} evals, {result.sweeps} sweeps)")
@@ -286,10 +294,19 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     if args.out:
         out = Path(args.out)
         out.parent.mkdir(parents=True, exist_ok=True)
-        out.write_text(
-            "\n".join(result.overlay_lines(manifest.get(
-                "device_kind", ""))) + "\n"
-        )
+        lines = result.overlay_lines(manifest.get("device_kind", ""))
+        if args.seed:
+            # the search ran WITH the seed's non-knob fits applied
+            # (host_bandwidth, ici.link_bandwidth ...); the emitted
+            # overlay must carry them or it won't reproduce the
+            # reported error — same merge bench.py does
+            lines += [
+                ln for ln in Path(args.seed).read_text().splitlines()
+                if ln.startswith("-") and not any(
+                    ln.startswith(f"-arch.{k} ") for k in result.values
+                )
+            ]
+        out.write_text("\n".join(lines) + "\n")
         print(f"overlay written to {out}")
     return 0
 
