@@ -245,3 +245,25 @@ def test_tune_power_meta_records_probe(tmp_path):
         assert "note" in doc["meta"]      # why no measurement exists
     else:
         assert doc["meta"]["measured_idle_watts"] > 0
+
+
+def test_hw_mode_uses_measured_duration():
+    """AccelWattch HW-mode analogue: activity counts are static program
+    properties, so power with measured device time is independent of the
+    timing model — half the duration at the same activity doubles the
+    dynamic power, and static/idle watts are duration-invariant."""
+    from tpusim.power.model import PowerModel
+    from tpusim.timing.engine import EngineResult
+
+    res = EngineResult(
+        seconds=1e-3, mxu_flops=1e12, flops=1.1e12,
+        hbm_bytes=1e9, vmem_bytes=1e9,
+    )
+    pm = PowerModel("v5e")
+    sim = pm.report(res)
+    hw = pm.report(res, measured_seconds=0.5e-3)
+    assert hw.seconds == 0.5e-3
+    assert hw.dynamic_joules == sim.dynamic_joules
+    sim_dyn_w = sim.dynamic_joules / sim.seconds
+    hw_dyn_w = hw.dynamic_joules / hw.seconds
+    assert hw_dyn_w == 2 * sim_dyn_w

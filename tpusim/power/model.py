@@ -152,7 +152,18 @@ class PowerModel:
             coeffs = coeffs.scaled(dvfs_scale)
         self.coeffs = coeffs
 
-    def report(self, result: EngineResult) -> PowerReport:
+    def report(
+        self, result: EngineResult, measured_seconds: float | None = None,
+    ) -> PowerReport:
+        """Power report from one execution's activity counts.
+
+        ``measured_seconds`` is the AccelWattch **HW-mode** slot
+        (``AccelWattch.md``: activity factors with real kernel
+        durations): the event counts are exact static properties of the
+        program, so substituting the measured device time for the
+        simulated time yields a power estimate independent of the timing
+        model's error — the form the hw-validation CSV pipeline compares
+        against NVML watts."""
         c = self.coeffs
         pj = {
             "mxu": c.mxu_pj_per_flop * result.mxu_flops,
@@ -164,8 +175,12 @@ class PowerModel:
             "vmem": c.vmem_pj_per_byte * result.vmem_bytes,
             "ici": c.ici_pj_per_byte * result.ici_bytes,
         }
+        seconds = (
+            measured_seconds if measured_seconds is not None
+            else result.seconds
+        )
         return PowerReport(
-            seconds=max(result.seconds, 1e-12),
+            seconds=max(seconds, 1e-12),
             component_joules={k: v * 1e-12 for k, v in pj.items()},
             static_watts=c.static_watts,
             idle_watts=c.idle_clock_watts,
