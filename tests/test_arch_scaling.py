@@ -1,0 +1,66 @@
+"""Cross-generation sanity: the presets must order real workloads the
+way the hardware does.  The reference ships one tested config per card
+and lets CI compare across them (QV100 / RTX2060 / RTX3070 matrix,
+``Jenkinsfile:26-52``); the TPU analogue is that a strictly-better chip
+(v5p: 2x the MXUs, 3.4x the HBM bandwidth, higher clock than v5e) must
+never simulate slower on the same program."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.timing.config import load_config
+from tpusim.timing.engine import Engine
+from tpusim.trace.format import load_trace, select_module
+
+REPO = Path(__file__).resolve().parent.parent
+SILICON = REPO / "reports" / "silicon"
+
+pytestmark = pytest.mark.skipif(
+    not (SILICON / "manifest.json").exists(),
+    reason="no committed silicon fixtures",
+)
+
+
+def _times(arch: str) -> dict[str, float]:
+    manifest = json.loads((SILICON / "manifest.json").read_text())
+    eng = Engine(load_config(arch=arch, tuned=False))
+    out = {}
+    for e in manifest["workloads"]:
+        td = load_trace(SILICON / e["trace"])
+        mod = select_module(td, e.get("module"))
+        out[e["name"]] = eng.run(mod).seconds
+    return out
+
+
+def test_v5p_never_slower_than_v5e():
+    v5e = _times("v5e")
+    v5p = _times("v5p")
+    for name, t_e in v5e.items():
+        assert v5p[name] <= t_e * 1.001, (
+            f"{name}: v5p {v5p[name] * 1e6:.1f}us vs v5e "
+            f"{t_e * 1e6:.1f}us — a strictly better chip must not lose"
+        )
+
+
+def test_bandwidth_bound_scales_with_hbm():
+    """elementwise_stream is HBM-bound: the v5p/v5e time ratio should
+    track the inverse bandwidth ratio (3.4x), not the clock ratio."""
+    v5e = _times("v5e")["elementwise_stream"]
+    v5p = _times("v5p")["elementwise_stream"]
+    speedup = v5e / v5p
+    bw_ratio = 2765e9 / 819e9
+    assert speedup == pytest.approx(bw_ratio, rel=0.25)
+
+
+def test_compute_bound_scales_with_peak_flops():
+    """matmul_chain is MXU-bound: speedup should track peak bf16 ratio
+    (v5p 459 TF/s vs v5e 219 TF/s at preset clocks)."""
+    v5e = _times("v5e")["matmul_chain"]
+    v5p = _times("v5p")["matmul_chain"]
+    speedup = v5e / v5p
+    flops_ratio = (2 * 8 * 128 * 128 * 1.75) / (2 * 4 * 128 * 128 * 1.67)
+    assert speedup == pytest.approx(flops_ratio, rel=0.3)
