@@ -114,7 +114,9 @@ def _cmd_correlate_ops(args: argparse.Namespace) -> int:
     )
     print(f"matched {len(corr.rows)} ops "
           f"({corr.matched_time_fraction:.0%} of device time); "
-          f"time-weighted |error| = {corr.weighted_abs_error_pct:.1f}%")
+          f"sync-op weighted |error| = "
+          f"{corr.sync_weighted_abs_error_pct:.1f}% "
+          f"(all rows {corr.weighted_abs_error_pct:.1f}%)")
     for r in corr.worst(args.top):
         print(f"  {r.name:40s} {r.opcode:16s} "
               f"sim={r.sim_ns:10.0f}ns real={r.real_ns:10.0f}ns "
