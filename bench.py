@@ -63,6 +63,8 @@ SUITE = [
     # silicon truth instead of hiding inside mixed workloads
     ("softmax_narrow", {"batch": 8, "seq": 1024, "heads": 8}, 32),
     ("relayout_copy", {"rows": 4096, "cols": 4096}, 32),
+    # quantized serving: first silicon measurement of the s8 dtype_mult
+    ("matmul_int8", {"m": 4096, "n": 4096, "k": 4096}, 16),
 ]
 
 ATTEMPTS = int(os.environ.get("TPUSIM_BENCH_ATTEMPTS", "3"))

@@ -13,6 +13,7 @@ parameters they expose:
 * ``op_overhead_cycles`` from a long chain of dependent tiny ops
 * ``vpu_transcendental_per_cycle`` from an exp/tanh stream
 * ``dtype_mult['f32']`` from the f32/bf16 matmul throughput ratio
+* ``dtype_mult['s8']``  from the int8/bf16 matmul throughput ratio
 * ``host_bandwidth``   from device_put round-trips
 * ``ici.link_bandwidth`` from a psum sweep (multi-chip hosts only)
 
@@ -40,6 +41,7 @@ class TunerResult:
     op_overhead_cycles: float | None = None
     transcendental_per_cycle: float | None = None
     f32_dtype_mult: float | None = None
+    s8_dtype_mult: float | None = None
     host_bandwidth: float | None = None
     ici_link_bandwidth: float | None = None
     details: dict | None = None
@@ -70,6 +72,10 @@ class TunerResult:
         if self.f32_dtype_mult:
             lines.append(
                 f"-arch.dtype_mult.f32 {self.f32_dtype_mult:.4g}"
+            )
+        if self.s8_dtype_mult:
+            lines.append(
+                f"-arch.dtype_mult.s8 {self.s8_dtype_mult:.4g}"
             )
         if self.host_bandwidth:
             lines.append(f"-arch.host_bandwidth {self.host_bandwidth:.4g}")
@@ -190,6 +196,15 @@ def _fit_f32_mult(mxu_achieved_bf16: float) -> float:
     return achieved_f32 / max(mxu_achieved_bf16, 1.0)
 
 
+def _fit_s8_mult(mxu_achieved_bf16: float) -> float:
+    """int8/bf16 matmul throughput ratio — the quantized-serving
+    dtype_mult entry (nominally 2.0, never silicon-measured before)."""
+    n = 4096
+    per_step = _per_step("matmul_int8", 8, m=n, n=n, k=n)
+    achieved_s8 = 2.0 * n ** 3 / per_step
+    return achieved_s8 / max(mxu_achieved_bf16, 1.0)
+
+
 def _fit_host_bw() -> float:
     """device_put of a large host buffer: host->HBM bandwidth."""
     import time
@@ -256,6 +271,7 @@ def tune(arch_name: str | None = None) -> TunerResult:
     overhead = _try("op_overhead_cycles", _fit_op_overhead, clock)
     transc = _try("transcendental_per_cycle", _fit_transcendental, clock)
     f32_mult = _try("f32_dtype_mult", _fit_f32_mult, mxu_achieved)
+    s8_mult = _try("s8_dtype_mult", _fit_s8_mult, mxu_achieved)
     host_bw = _try("host_bandwidth", _fit_host_bw)
     ici_bw = _try("ici_link_bandwidth", _fit_ici, arch)
 
@@ -269,6 +285,7 @@ def tune(arch_name: str | None = None) -> TunerResult:
         op_overhead_cycles=round(overhead, 1) if overhead else None,
         transcendental_per_cycle=round(transc, 1) if transc else None,
         f32_dtype_mult=round(f32_mult, 4) if f32_mult else None,
+        s8_dtype_mult=round(s8_mult, 4) if s8_mult else None,
         host_bandwidth=round(host_bw, 1) if host_bw else None,
         ici_link_bandwidth=round(ici_bw, 1) if ici_bw else None,
         details={

@@ -388,3 +388,29 @@ def build_relayout_copy(rows: int, cols: int):
         return x.T + jnp.bfloat16(1.0)
 
     return f, (x,)
+
+
+@register(
+    "matmul_int8",
+    description="int8 matmul with s32 accumulation — validates the "
+    "quantized-serving dtype_mult table entry (s8 nominally 2x bf16 "
+    "MACs/cycle, never silicon-measured before)",
+    suite="ubench",
+    m=4096, n=4096, k=4096,
+)
+def build_matmul_int8(m: int, n: int, k: int):
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.randint(ka, (m, k), -127, 127, jnp.int8)
+    b = jax.random.randint(kb, (k, n), -127, 127, jnp.int8)
+
+    def f(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    return f, (a, b)
