@@ -28,6 +28,7 @@ monitor_func_test.py:66-75``).
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -81,6 +82,179 @@ def log(msg: str) -> None:
 
 def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
+
+
+
+def refine_and_validate(
+    tuned_info: dict | None,
+    fixture_entries: list[dict],
+    device_kind: str,
+    fixture_dir: Path | None = None,
+) -> list | None:
+    """Refine the microbench overlay on the captured fixtures, then
+    self-validate the result against the preset — the unattended tail of
+    the live bench, factored jax-free so the round-end plumbing is
+    testable offline.
+
+    Refine: coordinate descent on the very objective the headline
+    reports, so the committed overlay improves on the seed by
+    construction (round-4 fix — a jointly-worse single-knob fit shipped
+    once and was only caught by rejection).  Validate: replay with tuned
+    vs preset config; a tuned overlay that WORSENS correlation is
+    renamed ``*.rejected`` (the reference only ships tuner output as
+    tested-cfgs after re-validation).  A refined overlay the validation
+    never confirmed is reverted to its seed.  Returns the replay rows
+    the headline must be computed from (the surviving config), or None
+    to keep the live-suite points."""
+    if not (tuned_info and fixture_entries):
+        return None
+    fixture_dir = fixture_dir or FIXTURE_DIR
+    from tpusim.timing.arch import detect_arch
+
+    arch_name = detect_arch(device_kind).name
+
+    refine_seed_text = None
+    try:
+        from tpusim.harness.refine import refine_arch_on_fixtures
+
+        overlay_path = REPO_ROOT / tuned_info["overlay"]
+        refine_seed_text = overlay_path.read_text()
+        rr = refine_arch_on_fixtures(
+            arch_name, fixture_entries, fixture_dir,
+            base_overlays=[overlay_path],
+        )
+        if not math.isfinite(rr.final_err_pct):
+            # final <= start, so an infinite FINAL means nothing ever
+            # replayed (an infinite start with finite final is a
+            # crash-at-seed the descent recovered from — keep that)
+            raise RuntimeError(
+                "no fixture workload replayed; refusing to label "
+                "preset values as a fit"
+            )
+        # merge: refined knobs + the tuner-only fits the refiner
+        # doesn't touch (host_bandwidth, ici.link_bandwidth)
+        keep = [
+            ln for ln in refine_seed_text.splitlines()
+            if ln.startswith("-") and not any(
+                ln.startswith(f"-arch.{k} ") for k in rr.values
+            )
+        ]
+        lines = rr.overlay_lines(device_kind) + keep
+        overlay_path.write_text("\n".join(lines) + "\n")
+        tuned_info["refined"] = {
+            "replay_err_pct": {
+                "seed": round(rr.start_err_pct, 2),
+                "final": round(rr.final_err_pct, 2),
+            },
+            "changed": {
+                k: float(f"{v:.6g}") for k, v in rr.changed.items()
+            },
+            "evals": rr.evals,
+        }
+        log(f"bench: replay-refined overlay: {rr.start_err_pct:.2f}% "
+            f"-> {rr.final_err_pct:.2f}% ({rr.evals} evals)")
+    except Exception as e:
+        log(f"bench: replay refinement FAILED (microbench fit kept): "
+            f"{type(e).__name__}: {e}")
+
+    headline_rows = None
+    try:
+        from tpusim.timing.config import load_config
+        from tpusim.timing.engine import Engine
+
+        means = {}
+        rows_by = {}
+        for label, tuned_flag in (("tuned", True), ("preset", False)):
+            eng = Engine(load_config(arch=arch_name, tuned=tuned_flag))
+            rows = replay_fixture_errors(
+                eng, fixture_entries, fixture_dir,
+            )
+            if rows:
+                rows_by[label] = rows
+        if "tuned" in rows_by and "preset" in rows_by:
+            # compare over the INTERSECTION of successfully replayed
+            # workloads: pathological tuned parameters that crash the
+            # replay of the worst workload must not win by averaging
+            # over an easier subset
+            common = (
+                {r[0] for r in rows_by["tuned"]}
+                & {r[0] for r in rows_by["preset"]}
+            )
+            for label, rows in rows_by.items():
+                kept = [r for r in rows if r[0] in common]
+                if kept:
+                    means[label] = (
+                        sum(abs(r[3]) for r in kept) / len(kept)
+                    )
+            dropped_t = len(rows_by["tuned"]) - len(common)
+            dropped_p = len(rows_by["preset"]) - len(common)
+            if dropped_t or dropped_p or not common:
+                log(
+                    f"bench: overlay validation subset: "
+                    f"{len(common)} common workloads "
+                    f"(tuned dropped {dropped_t}, preset dropped "
+                    f"{dropped_p})"
+                )
+        else:
+            log("bench: overlay validation skipped — one side "
+                "returned no replayable rows")
+        if "tuned" in means and "preset" in means:
+            tuned_info["replay_mean_abs_err_pct"] = {
+                k: round(v, 2) for k, v in means.items()
+            }
+            if means["tuned"] > means["preset"] + 1.0:
+                op = Path(REPO_ROOT / tuned_info["overlay"])
+                rejected_path = op.with_suffix(op.suffix + ".rejected")
+                op.rename(rejected_path)
+                tuned_info["rejected"] = True
+                tuned_info["overlay"] = os.path.relpath(
+                    rejected_path, REPO_ROOT
+                )
+                # the suite's points were simulated WITH the bad
+                # overlay; the headline must reflect the config that
+                # survives (the preset replay, same silicon truths)
+                headline_rows = rows_by["preset"]
+                log(
+                    f"bench: tuned overlay REJECTED (replay "
+                    f"{means['tuned']:.1f}% vs preset "
+                    f"{means['preset']:.1f}%); kept as {op}.rejected"
+                )
+            else:
+                if tuned_info.get("refined"):
+                    # the suite's live sims predate the refinement;
+                    # the headline must reflect the overlay that is
+                    # actually committed (same engine, same truths)
+                    headline_rows = rows_by["tuned"]
+                log(
+                    f"bench: tuned overlay validated (replay "
+                    f"{means['tuned']:.1f}% vs preset "
+                    f"{means['preset']:.1f}%)"
+                )
+    except Exception as e:
+        log(f"bench: overlay self-validation FAILED: "
+            f"{type(e).__name__}: {e}")
+
+    if (
+        tuned_info is not None
+        and tuned_info.get("refined")
+        and headline_rows is None
+        and not tuned_info.get("rejected")
+        and refine_seed_text is not None
+    ):
+        # the refiner rewrote the overlay but the self-validation never
+        # confirmed it (skipped or raised): an unvalidated fit must not
+        # become the committed config while the headline reflects the
+        # seed — restore the seed overlay so artifact and number agree
+        try:
+            (REPO_ROOT / tuned_info["overlay"]).write_text(refine_seed_text)
+            tuned_info["refined"]["reverted"] = "validation did not run"
+            log("bench: refined overlay REVERTED to seed "
+                "(self-validation did not confirm it)")
+        except Exception as e:
+            log(f"bench: refined-overlay revert FAILED: "
+                f"{type(e).__name__}: {e}")
+    return headline_rows
+
 
 
 # --------------------------------------------------------------------------
@@ -195,155 +369,9 @@ def child_main() -> int:
         except Exception as e:  # keep the suite alive; report what ran
             log(f"bench: {name} FAILED: {type(e).__name__}: {e}")
 
-    # replay-refine the microbench fit against the just-captured fixtures:
-    # coordinate descent on the very objective the headline reports, so
-    # the committed overlay improves on the seed by construction (round-4
-    # fix — a jointly-worse single-knob fit shipped and was rejected by
-    # the validation below; the refiner makes acceptance the normal case)
-    refine_seed_text = None
-    if tuned_info and fixture_entries:
-        try:
-            from tpusim.harness.refine import refine_arch_on_fixtures
-            from tpusim.timing.arch import detect_arch
-
-            overlay_path = REPO_ROOT / tuned_info["overlay"]
-            refine_seed_text = overlay_path.read_text()
-            rr = refine_arch_on_fixtures(
-                detect_arch(dev.device_kind).name,
-                fixture_entries, FIXTURE_DIR,
-                base_overlays=[overlay_path],
-            )
-            # merge: refined knobs + the tuner-only fits the refiner
-            # doesn't touch (host_bandwidth, ici.link_bandwidth)
-            keep = [
-                ln for ln in overlay_path.read_text().splitlines()
-                if ln.startswith("-") and not any(
-                    ln.startswith(f"-arch.{k} ") for k in rr.values
-                )
-            ]
-            lines = rr.overlay_lines(dev.device_kind) + keep
-            overlay_path.write_text("\n".join(lines) + "\n")
-            tuned_info["refined"] = {
-                "replay_err_pct": {
-                    "seed": round(rr.start_err_pct, 2),
-                    "final": round(rr.final_err_pct, 2),
-                },
-                "changed": {
-                    k: float(f"{v:.6g}") for k, v in rr.changed.items()
-                },
-                "evals": rr.evals,
-            }
-            log(f"bench: replay-refined overlay: {rr.start_err_pct:.2f}% "
-                f"-> {rr.final_err_pct:.2f}% ({rr.evals} evals)")
-        except Exception as e:
-            log(f"bench: replay refinement FAILED (microbench fit kept): "
-                f"{type(e).__name__}: {e}")
-
-    # self-validate the fit before it becomes the committed config: replay
-    # the just-captured fixtures (same silicon truths) with tuned vs
-    # preset parameters; a tuned overlay that WORSENS correlation is
-    # renamed *.rejected instead of silently poisoning every later run —
-    # the reference only ships tuner output as tested-cfgs after
-    # re-validation (Jenkinsfile correlation publish)
-    headline_rows = None
-    if tuned_info and fixture_entries:
-        try:
-            from tpusim.timing.arch import detect_arch
-            from tpusim.timing.config import load_config
-            from tpusim.timing.engine import Engine
-
-            arch_name = detect_arch(dev.device_kind).name
-            means = {}
-            rows_by = {}
-            for label, tuned_flag in (("tuned", True), ("preset", False)):
-                eng = Engine(load_config(arch=arch_name, tuned=tuned_flag))
-                rows = replay_fixture_errors(
-                    eng, fixture_entries, FIXTURE_DIR,
-                )
-                if rows:
-                    rows_by[label] = rows
-            if "tuned" in rows_by and "preset" in rows_by:
-                # compare over the INTERSECTION of successfully replayed
-                # workloads: pathological tuned parameters that crash the
-                # replay of the worst workload must not win by averaging
-                # over an easier subset
-                common = (
-                    {r[0] for r in rows_by["tuned"]}
-                    & {r[0] for r in rows_by["preset"]}
-                )
-                for label, rows in rows_by.items():
-                    kept = [r for r in rows if r[0] in common]
-                    if kept:
-                        means[label] = (
-                            sum(abs(r[3]) for r in kept) / len(kept)
-                        )
-                dropped_t = len(rows_by["tuned"]) - len(common)
-                dropped_p = len(rows_by["preset"]) - len(common)
-                if dropped_t or dropped_p or not common:
-                    log(
-                        f"bench: overlay validation subset: "
-                        f"{len(common)} common workloads "
-                        f"(tuned dropped {dropped_t}, preset dropped "
-                        f"{dropped_p})"
-                    )
-            else:
-                log("bench: overlay validation skipped — one side "
-                    "returned no replayable rows")
-            if "tuned" in means and "preset" in means:
-                tuned_info["replay_mean_abs_err_pct"] = {
-                    k: round(v, 2) for k, v in means.items()
-                }
-                if means["tuned"] > means["preset"] + 1.0:
-                    op = Path(REPO_ROOT / tuned_info["overlay"])
-                    rejected_path = op.with_suffix(op.suffix + ".rejected")
-                    op.rename(rejected_path)
-                    tuned_info["rejected"] = True
-                    tuned_info["overlay"] = str(
-                        rejected_path.relative_to(REPO_ROOT)
-                    )
-                    # the suite's points were simulated WITH the bad
-                    # overlay; the headline must reflect the config that
-                    # survives (the preset replay, same silicon truths)
-                    headline_rows = rows_by["preset"]
-                    log(
-                        f"bench: tuned overlay REJECTED (replay "
-                        f"{means['tuned']:.1f}% vs preset "
-                        f"{means['preset']:.1f}%); kept as {op}.rejected"
-                    )
-                else:
-                    if tuned_info.get("refined"):
-                        # the suite's live sims predate the refinement;
-                        # the headline must reflect the overlay that is
-                        # actually committed (same engine, same truths)
-                        headline_rows = rows_by["tuned"]
-                    log(
-                        f"bench: tuned overlay validated (replay "
-                        f"{means['tuned']:.1f}% vs preset "
-                        f"{means['preset']:.1f}%)"
-                    )
-        except Exception as e:
-            log(f"bench: overlay self-validation FAILED: "
-                f"{type(e).__name__}: {e}")
-
-    if (
-        tuned_info is not None
-        and tuned_info.get("refined")
-        and headline_rows is None
-        and not tuned_info.get("rejected")
-        and refine_seed_text is not None
-    ):
-        # the refiner rewrote the overlay but the self-validation never
-        # confirmed it (skipped or raised): an unvalidated fit must not
-        # become the committed config while the headline reflects the
-        # seed — restore the seed overlay so artifact and number agree
-        try:
-            (REPO_ROOT / tuned_info["overlay"]).write_text(refine_seed_text)
-            tuned_info["refined"]["reverted"] = "validation did not run"
-            log("bench: refined overlay REVERTED to seed "
-                "(self-validation did not confirm it)")
-        except Exception as e:
-            log(f"bench: refined-overlay revert FAILED: "
-                f"{type(e).__name__}: {e}")
+    headline_rows = refine_and_validate(
+        tuned_info, fixture_entries, dev.device_kind,
+    )
 
     if save_fixtures and fixture_entries:
         try:

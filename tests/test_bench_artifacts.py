@@ -186,3 +186,101 @@ def test_committed_fixtures_meet_the_north_star(capsys, monkeypatch):
         f"fixture-mode mean |error| {out['value']}% exceeds the 15% "
         f"north-star; detail: {out['detail']}"
     )
+
+
+# -- refine_and_validate: the unattended live-bench tail, offline ----------
+
+def _seed_overlay(tmp_path, monkeypatch):
+    """Point the tuned-overlay machinery at a temp configs dir holding a
+    microbench-style seed; returns (bench module, tuned_info, manifest
+    workload entries).
+
+    Uses the COMMITTED silicon fixtures as the replay truth — the same
+    data the live tail would see."""
+    import bench
+
+    manifest = json.loads(
+        (REPO_ROOT / "reports" / "silicon" / "manifest.json").read_text()
+    )
+    cfg_dir = tmp_path / "configs"
+    cfg_dir.mkdir()
+    seed = cfg_dir / "v5e.tuned.flags"
+    seed.write_text(
+        "# seed fit\n"
+        "-arch.hbm_efficiency 0.803\n"
+        "-arch.host_bandwidth 3.9e+07\n"
+    )
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(cfg_dir))
+    # overlay path handling in bench is REPO_ROOT-relative
+    tuned_info = {"overlay": os.path.relpath(seed, REPO_ROOT), "fit": {}}
+    return bench, tuned_info, manifest["workloads"]
+
+
+@pytest.mark.skipif(
+    not (REPO_ROOT / "reports" / "silicon" / "manifest.json").exists(),
+    reason="no committed silicon fixtures",
+)
+def test_refine_and_validate_accepts_and_merges(tmp_path, monkeypatch):
+    """Happy path: the refined overlay validates, keeps the seed's
+    non-knob fits, records the refinement, and returns tuned replay rows
+    for the headline."""
+    bench, tuned_info, entries = _seed_overlay(tmp_path, monkeypatch)
+    rows = bench.refine_and_validate(
+        tuned_info, entries, "TPU v5 lite",
+        fixture_dir=REPO_ROOT / "reports" / "silicon",
+    )
+    assert tuned_info.get("refined"), "refinement must run and be recorded"
+    assert not tuned_info.get("rejected")
+    overlay_text = (REPO_ROOT / tuned_info["overlay"]).read_text()
+    # seed-only fit preserved alongside refined knobs
+    assert "-arch.host_bandwidth 3.9e+07" in overlay_text
+    assert "-arch.hbm_efficiency" in overlay_text
+    # validated refinement switches the headline to tuned replay rows
+    assert rows is not None and len(rows) > 0
+    final = tuned_info["refined"]["replay_err_pct"]["final"]
+    assert final <= tuned_info["refined"]["replay_err_pct"]["seed"]
+
+
+@pytest.mark.skipif(
+    not (REPO_ROOT / "reports" / "silicon" / "manifest.json").exists(),
+    reason="no committed silicon fixtures",
+)
+def test_refine_and_validate_reverts_without_validation(
+    tmp_path, monkeypatch,
+):
+    """When refinement succeeds but the self-validation replay returns
+    no rows (both sides empty), the refined overlay must be reverted to
+    the seed — an unvalidated fit must not become the committed config."""
+    bench, tuned_info, entries = _seed_overlay(tmp_path, monkeypatch)
+    seed_text = (REPO_ROOT / tuned_info["overlay"]).read_text()
+    # the refiner replays internally; only the VALIDATION uses
+    # bench.replay_fixture_errors — starve it so validation is skipped
+    monkeypatch.setattr(bench, "replay_fixture_errors", lambda *a, **k: [])
+    rows = bench.refine_and_validate(
+        tuned_info, entries, "TPU v5 lite",
+        fixture_dir=REPO_ROOT / "reports" / "silicon",
+    )
+    assert rows is None
+    assert tuned_info.get("refined", {}).get("reverted")
+    assert (REPO_ROOT / tuned_info["overlay"]).read_text() == seed_text
+
+
+def test_refine_and_validate_refuses_empty_fixture_set(
+    tmp_path, monkeypatch,
+):
+    """Entries whose traces don't exist: the refiner must refuse to
+    label preset values as a fit — no overlay rewrite, no 'refined'
+    record, no headline replacement."""
+    bench, tuned_info, entries = _seed_overlay(tmp_path, monkeypatch)
+    seed_text = (REPO_ROOT / tuned_info["overlay"]).read_text()
+    bogus = [
+        {"name": "nope", "trace": "does_not_exist", "n_steps": 1,
+         "real_seconds": 1e-3}
+    ]
+    rows = bench.refine_and_validate(
+        tuned_info, bogus, "TPU v5 lite",
+        fixture_dir=REPO_ROOT / "reports" / "silicon",
+    )
+    assert rows is None
+    assert "refined" not in tuned_info
+    assert (REPO_ROOT / tuned_info["overlay"]).read_text() == seed_text
