@@ -226,3 +226,25 @@ def test_tuner_on_live_chip(live_jax):
     assert 1.0 <= result.vpu_reduce_slowdown < 64, result
     lines = result.overlay_lines()
     assert any("clock_ghz" in l for l in lines)
+
+def test_diff_stats_compares_two_runs():
+    """The merge-stats compare role (util/plotting/merge-stats.py): two
+    configs over the same runs, numeric tolerance, one-sided runs."""
+    from tpusim.harness.scrape import diff_stats
+
+    old = {
+        "a/run.log": {"cycles": 100.0, "flops": 5.0, "note": "x"},
+        "gone/run.log": {"cycles": 1.0},
+        "__failed__": {"runs": ["dead"]},
+    }
+    new = {
+        "a/run.log": {"cycles": 103.0, "flops": 5.0, "note": "y"},
+        "fresh/run.log": {"cycles": 2.0},
+    }
+    d = diff_stats(old, new, rel_tol=0.05)
+    # 3% cycle delta is inside the 5% tolerance; note differs exactly
+    assert d["a/run.log"] == {"note": ("x", "y")}
+    assert "gone/run.log" in d["__only_old__"]
+    assert "fresh/run.log" in d["__only_new__"]
+    strict = diff_stats(old, new)
+    assert strict["a/run.log"]["cycles"] == (100.0, 103.0)

@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import csv
 import json
+import math
 import re
 from pathlib import Path
 from typing import Iterable
 
 from tpusim.sim.stats import EXIT_SENTINEL, STAT_PREFIX
 
-__all__ = ["scrape_log", "scrape_run_dirs", "write_csv"]
+__all__ = ["scrape_log", "scrape_run_dirs", "write_csv", "diff_stats"]
 
 _STAT_RE = re.compile(
     rf"^{re.escape(STAT_PREFIX)}(?P<name>[\w.]+)\s*=\s*(?P<value>\S+)\s*$"
@@ -103,3 +104,48 @@ def write_csv(
         w.writerow(["run"] + cols)
         for run, stats in sorted(rows.items()):
             w.writerow([run] + [stats.get(c, "") for c in cols])
+
+
+def diff_stats(
+    old: dict[str, dict[str, object]],
+    new: dict[str, dict[str, object]],
+    rel_tol: float = 0.0,
+) -> dict[str, dict[str, tuple]]:
+    """Per-run, per-stat differences between two scraped stat sets — the
+    compare role of the reference's ``util/plotting/merge-stats.py``
+    (two builds / two configs over the same app list).
+
+    Returns ``{run: {stat: (old, new)}}`` for every run present in both
+    sets where a stat differs beyond ``rel_tol`` (numeric) or at all
+    (non-numeric); runs present on only one side appear under
+    ``"__only_old__"`` / ``"__only_new__"``."""
+    out: dict[str, dict[str, tuple]] = {}
+    old = {k: v for k, v in old.items() if k != "__failed__"}
+    new = {k: v for k, v in new.items() if k != "__failed__"}
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    if only_old:
+        out["__only_old__"] = {r: ((), ()) for r in only_old}
+    if only_new:
+        out["__only_new__"] = {r: ((), ()) for r in only_new}
+    for run in sorted(set(old) & set(new)):
+        diffs: dict[str, tuple] = {}
+        for stat in sorted(set(old[run]) | set(new[run])):
+            a, b = old[run].get(stat), new[run].get(stat)
+            if a == b:
+                continue
+            if isinstance(a, float) and isinstance(b, float) and (
+                math.isnan(a) and math.isnan(b)
+            ):
+                continue  # two NaNs are the same (non-)measurement
+            if (
+                isinstance(a, (int, float)) and isinstance(b, (int, float))
+                and rel_tol > 0
+            ):
+                denom = max(abs(a), abs(b), 1e-12)
+                if abs(a - b) / denom <= rel_tol:
+                    continue
+            diffs[stat] = (a, b)
+        if diffs:
+            out[run] = diffs
+    return out
