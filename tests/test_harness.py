@@ -248,3 +248,32 @@ def test_diff_stats_compares_two_runs():
     assert "fresh/run.log" in d["__only_new__"]
     strict = diff_stats(old, new)
     assert strict["a/run.log"]["cycles"] == (100.0, 103.0)
+
+
+def test_stats_diff_cli(tmp_path):
+    import subprocess
+    import sys
+
+    for side, cyc in (("old", 100), ("new", 110)):
+        d = tmp_path / side / "runA"
+        d.mkdir(parents=True)
+        (d / "run.log").write_text(
+            f"tpusim_tot_sim_cycles = {cyc}\n"
+            "TPUSIM: *** exit detected ***\n"
+        )
+    p = subprocess.run(
+        [sys.executable, "-m", "tpusim", "stats-diff",
+         str(tmp_path / "old"), str(tmp_path / "new"), "--check"],
+        capture_output=True, text=True,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert p.returncode == 1
+    assert "tot_sim_cycles 100" in p.stdout
+    p2 = subprocess.run(
+        [sys.executable, "-m", "tpusim", "stats-diff",
+         str(tmp_path / "old"), str(tmp_path / "new"),
+         "--rel-tol", "0.2"],
+        capture_output=True, text=True,
+        cwd=Path(__file__).resolve().parent.parent,
+    )
+    assert p2.returncode == 0

@@ -342,6 +342,28 @@ def _cmd_occupancy(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_stats_diff(args: argparse.Namespace) -> int:
+    """Compare two scraped run trees — the merge-stats compare role
+    (two builds / two configs over the same app list)."""
+    from tpusim.harness.scrape import diff_stats, scrape_run_dirs
+
+    old = scrape_run_dirs(args.old)
+    new = scrape_run_dirs(args.new)
+    diffs = diff_stats(old, new, rel_tol=args.rel_tol)
+    if not diffs:
+        print("no differences")
+        return 0
+    for run in sorted(diffs):
+        if run in ("__only_old__", "__only_new__"):
+            side = "only in OLD" if run == "__only_old__" else "only in NEW"
+            for r in sorted(diffs[run]):
+                print(f"{r}: {side}")
+            continue
+        for stat, (a, b) in sorted(diffs[run].items()):
+            print(f"{run}: {stat} {a} -> {b}")
+    return 1 if args.check else 0
+
+
 def _cmd_workloads(args: argparse.Namespace) -> int:
     from tpusim.models import list_workloads
 
@@ -459,6 +481,18 @@ def main(argv: list[str] | None = None) -> int:
     pf.add_argument("--out", default=None,
                     help="write the refined overlay here")
     pf.set_defaults(fn=_cmd_refine)
+
+    psd = sub.add_parser(
+        "stats-diff",
+        help="diff two scraped run trees (merge-stats compare role)",
+    )
+    psd.add_argument("old", help="run dir of the baseline")
+    psd.add_argument("new", help="run dir of the candidate")
+    psd.add_argument("--rel-tol", type=float, default=0.0,
+                     help="relative tolerance for numeric stats")
+    psd.add_argument("--check", action="store_true",
+                     help="exit 1 when any difference is found (CI gate)")
+    psd.set_defaults(fn=_cmd_stats_diff)
 
     pw = sub.add_parser("workloads", help="list registered workloads")
     pw.set_defaults(fn=_cmd_workloads)
