@@ -227,7 +227,9 @@ def _alloc_vmem_bytes(op: TraceOp, is_entry: bool) -> float:
     if op.opcode in FREE_OPCODES or op.base in FREE_OPCODES:
         if not (is_entry and op.opcode == "parameter"):
             return 0.0
-    if op.base in ("while", "conditional") or op.is_async_done:
+    if op.base in ("while", "conditional", "call") or op.is_async_done:
+        # while/conditional/call results alias their init/branch/callee-root
+        # values — the callee's own walk already counts the allocation
         return 0.0
     if not is_entry and op.base == "dynamic-update-slice":
         return 0.0
@@ -458,9 +460,14 @@ class Engine:
         dma_free = t0
         pending: dict[str, float] = {}  # async op name -> finish cycle
         dma_names: set[str] = set()     # pending entries on the DMA channel
-        # horizon until which the async DMA channel is draining HBM; the
-        # queue's remaining bytes at time t are (horizon - t) * bandwidth
+        # horizon until which the async DMA channel is draining HBM, plus
+        # the in-flight transfer segments [start, end, bytes/cycle] — the
+        # queue's remaining bytes at time t are summed from the segments
+        # at each transfer's OWN rate (a relayout-derated copy queues its
+        # bytes slowly; converting its horizon at pin rate would inflate
+        # the fair-share penalty)
         dma_busy_until = t0
+        dma_segments: list[list[float]] = []
         hbm_bpc = a.hbm_bytes_per_cycle
         dma_lat = a.seconds_to_cycles(a.dma_issue_latency)
         contend = self.config.model_hbm_contention
@@ -622,6 +629,10 @@ class Engine:
                 dma_free = start + dur
                 if cost.hbm_bytes > 0:
                     dma_busy_until = max(dma_busy_until, start + dur)
+                    if dur > 0:
+                        dma_segments.append(
+                            [start, start + dur, cost.hbm_bytes / dur]
+                        )
                 result.dma_cycles += dur
                 result.unit_busy_cycles[Unit.DMA.value] += dur
                 result.opcode_cycles[base] += dur
@@ -646,7 +657,10 @@ class Engine:
                 # fair-share split: while both are active each gets half
                 # the bandwidth, so each side pays the overlapped bytes
                 # once more (the FR-FCFS-scheduler slot, dram_sched.h:41)
-                q_bytes = (dma_busy_until - t) * hbm_bpc
+                dma_segments = [s for s in dma_segments if s[1] > t]
+                q_bytes = sum(
+                    s[2] * (s[1] - max(t, s[0])) for s in dma_segments
+                )
                 shared = min(cost.hbm_bytes, q_bytes)
                 penalty = shared / hbm_bpc
                 hbm_time = (
@@ -672,6 +686,20 @@ class Engine:
                         pending[name] = fin + penalty
                 dma_free += penalty
                 dma_busy_until += penalty
+                for s in dma_segments:
+                    # the in-flight transfers are delayed by the same
+                    # bandwidth loss their queue inflicted on this op;
+                    # an already-started segment keeps its remaining
+                    # bytes and drains them over the stretched window
+                    if s[0] >= t:
+                        s[0] += penalty
+                        s[1] += penalty
+                    else:
+                        remaining = s[2] * (s[1] - t)
+                        s[0] = t
+                        s[1] += penalty
+                        if s[1] > t:
+                            s[2] = remaining / (s[1] - t)
                 dur = new_dur
             if dur > 0:
                 self._emit(result, op, t, t + dur, cost.unit)
