@@ -115,13 +115,21 @@ def refine_and_validate(
 
     refine_seed_text = None
     try:
-        from tpusim.harness.refine import refine_arch_on_fixtures
+        from tpusim.harness.refine import (
+            load_per_op_rows, refine_arch_on_fixtures,
+        )
 
         overlay_path = REPO_ROOT / tuned_info["overlay"]
         refine_seed_text = overlay_path.read_text()
+        # joint objective: e2e totals + the committed artifact's per-op
+        # device durations (ten totals cannot constrain fifteen knobs;
+        # the ~120 matched per-op durations can — VERDICT r4 #3)
         rr = refine_arch_on_fixtures(
             arch_name, fixture_entries, fixture_dir,
             base_overlays=[overlay_path],
+            per_op_rows=load_per_op_rows(
+                REPO_ROOT / "reports" / "correl_ops.json"
+            ),
         )
         if not math.isfinite(rr.final_err_pct):
             # final <= start, so an infinite FINAL means nothing ever
@@ -146,6 +154,7 @@ def refine_and_validate(
                 "seed": round(rr.start_err_pct, 2),
                 "final": round(rr.final_err_pct, 2),
             },
+            **({"parts": rr.parts} if rr.parts else {}),
             "changed": {
                 k: float(f"{v:.6g}") for k, v in rr.changed.items()
             },

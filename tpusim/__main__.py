@@ -127,6 +127,28 @@ def _cmd_correlate_ops(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_correl_regen(args: argparse.Namespace) -> int:
+    """Regenerate the committed per-op correlation artifact offline: the
+    CURRENT model replayed against the device durations stored in the
+    existing artifact.  Run after any timing-model change (the fast tier
+    rejects a stale committed artifact by model-version stamp)."""
+    from tpusim.harness.correl_ops import regenerate_offline
+
+    doc = regenerate_offline(
+        args.artifact, fixture_dir=args.fixtures, arch=args.arch,
+        out_path=args.out or args.artifact,
+    )
+    print(
+        f"correl-regen: {len(doc['workloads'])} workloads, "
+        f"mean sync-op weighted |error| = "
+        f"{doc['mean_sync_weighted_abs_error_pct']}% "
+        f"(all rows {doc['mean_weighted_abs_error_pct']}%), "
+        f"model_version {doc['model_version']} "
+        f"-> {args.out or args.artifact}"
+    )
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     from tpusim.trace.format import load_trace
 
@@ -266,7 +288,9 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     the joint fit on the objective bench reports)."""
     import math
 
-    from tpusim.harness.refine import refine_arch_on_fixtures
+    from tpusim.harness.refine import (
+        load_per_op_rows, refine_arch_on_fixtures,
+    )
 
     fixture_dir = Path(args.fixtures)
     manifest_path = fixture_dir / "manifest.json"
@@ -276,9 +300,13 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     manifest = json.loads(manifest_path.read_text())
     arch = args.arch or manifest.get("arch", "v5e")
     seed = [args.seed] if args.seed else []
+    per_op_rows = (
+        {} if args.no_per_op else load_per_op_rows(args.per_op_artifact)
+    )
     result = refine_arch_on_fixtures(
         arch, manifest.get("workloads", []), fixture_dir,
         base_overlays=seed, max_sweeps=args.sweeps,
+        per_op_rows=per_op_rows,
     )
     if not math.isfinite(result.start_err_pct):
         # no fixture replayed: an "overlay" of untouched preset values
@@ -288,9 +316,14 @@ def _cmd_refine(args: argparse.Namespace) -> int:
             f"nothing to refine", file=sys.stderr,
         )
         return 1
-    print(f"fixture replay: {result.start_err_pct:.2f}% -> "
-          f"{result.final_err_pct:.2f}% mean |error| "
-          f"({result.evals} evals, {result.sweeps} sweeps)")
+    print(f"fixture replay objective: {result.start_err_pct:.2f} -> "
+          f"{result.final_err_pct:.2f} "
+          f"({result.evals} evals, {result.sweeps} sweeps; "
+          f"{result.replayed}/{result.total} fixtures)")
+    if result.parts:
+        print("  parts: " + ", ".join(
+            f"{k}={v:.2f}" for k, v in sorted(result.parts.items())
+        ))
     for k, v in sorted(result.changed.items()):
         print(f"  {k} -> {v:.6g}")
     if args.out:
@@ -446,6 +479,18 @@ def main(argv: list[str] | None = None) -> int:
     pco.add_argument("--json", default=None, help="write correl_ops.json")
     pco.set_defaults(fn=_cmd_correlate_ops)
 
+    pcr = sub.add_parser(
+        "correl-regen",
+        help="regenerate the per-op correlation artifact offline "
+             "(current model vs the artifact's stored device durations)",
+    )
+    pcr.add_argument("--artifact", default="reports/correl_ops.json")
+    pcr.add_argument("--fixtures", default="reports/silicon")
+    pcr.add_argument("--arch", default="v5e")
+    pcr.add_argument("--out", default=None,
+                     help="output path (default: overwrite --artifact)")
+    pcr.set_defaults(fn=_cmd_correl_regen)
+
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")
     pi.set_defaults(fn=_cmd_info)
@@ -480,6 +525,14 @@ def main(argv: list[str] | None = None) -> int:
     pf.add_argument("--sweeps", type=int, default=6)
     pf.add_argument("--out", default=None,
                     help="write the refined overlay here")
+    pf.add_argument(
+        "--per-op-artifact", default="reports/correl_ops.json",
+        help="per-op artifact whose device rows join the objective",
+    )
+    pf.add_argument(
+        "--no-per-op", action="store_true",
+        help="fit on end-to-end totals only (the pre-round-5 objective)",
+    )
     pf.set_defaults(fn=_cmd_refine)
 
     psd = sub.add_parser(
