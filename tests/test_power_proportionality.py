@@ -1,0 +1,88 @@
+"""Energy-proportionality sanity for TPUWattch (VERDICT r4 #7).
+
+No watts are measurable in this environment (probe evidence committed in
+``reports/silicon/manifest.json .power_probe`` and the fitted-coeff
+meta), so the coefficients cannot be validated absolutely — but
+published figures still imply testable RATIOS and bands:
+
+* HBM2e/3 access energy is ~3.9 pJ/bit ≈ 31 pJ/byte (public memory-
+  vendor figures) — the fitted coefficient must land within 2x;
+* a v5e board is a ~200W TDP class part — a compute-bound matmul chain
+  replayed in HW-mode (real device durations) must draw average power
+  within 2x of that band, and strictly more than a bandwidth-bound
+  elementwise stream (compute-bound kernels run hotter);
+* energy composition must track the workload: MXU joules dominate the
+  matmul chain, HBM joules dominate the elementwise stream.
+
+Reference slot: ``util/accelwattch/hw_power_validation_volta.csv``
+methodology (measured-watts fit) — degraded honestly to ratio checks
+until a telemetry-capable TPU-VM is available.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def reports():
+    from tpusim.power.model import PowerModel
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    fd = REPO / "reports" / "silicon"
+    entries = {
+        e["name"]: e
+        for e in json.loads((fd / "manifest.json").read_text())["workloads"]
+    }
+    eng = Engine(load_config(arch="v5e"))
+    pm = PowerModel("v5e")
+    out = {}
+    for name in ("matmul_chain", "elementwise_stream"):
+        e = entries[name]
+        mod = select_module(load_trace(fd / e["trace"]), e.get("module"))
+        res = eng.run(mod)
+        # HW-mode: activity counts are exact; duration is the DEVICE
+        # truth, so the ratio test cannot be polluted by timing error
+        steps = float(e.get("n_steps", 1))
+        out[name] = pm.report(
+            res, measured_seconds=float(e["real_seconds"]) * steps,
+        )
+    return out
+
+
+def test_hbm_energy_coefficient_within_published_band():
+    from tpusim.power.model import PowerModel
+
+    pj_per_byte = PowerModel("v5e").coeffs.hbm_pj_per_byte
+    # HBM2e/3 ~3.9 pJ/bit = 31.2 pJ/byte; pin within 2x either way
+    assert 31.2 / 2 <= pj_per_byte <= 31.2 * 2, pj_per_byte
+
+
+def test_board_power_band(reports):
+    watts = reports["matmul_chain"].avg_watts
+    # v5e ~200W TDP class; within 2x either way
+    assert 100.0 <= watts <= 400.0, watts
+
+
+def test_compute_bound_runs_hotter_than_bandwidth_bound(reports):
+    assert (
+        reports["matmul_chain"].avg_watts
+        > reports["elementwise_stream"].avg_watts
+    ), (
+        reports["matmul_chain"].avg_watts,
+        reports["elementwise_stream"].avg_watts,
+    )
+
+
+def test_energy_composition_tracks_workload(reports):
+    mm = reports["matmul_chain"].component_joules
+    ew = reports["elementwise_stream"].component_joules
+    assert mm.get("mxu", 0) > mm.get("hbm", 0), mm
+    assert ew.get("hbm", 0) > ew.get("mxu", 0), ew

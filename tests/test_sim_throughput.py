@@ -1,0 +1,52 @@
+"""Simulator-throughput regression guard (VERDICT r4 #8).
+
+The engine got 2.5x faster in round 4 (commit f42c7a0) and prints
+``sim_rate_kops``/``silicon_slowdown`` (the ``gpgpu_simulation_rate``
+analogue, ``gpgpusim_entrypoint.cc:262-268``); nothing pinned it, so a
+future fidelity fix could silently cost 10x sim speed.  This replays the
+committed fixture set (real workload mix, zero jax) under a wall-clock
+budget and a floor on ops simulated per host-second.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: very generous floors — ~25x below the currently-measured 570 kops/s,
+#: so only a genuine order-of-magnitude regression (an O(n^2) walk, a
+#: cache dropped) trips them, not a loaded CI runner.  Override with
+#: TPUSIM_MIN_KOPS for slower machines.
+MIN_KOPS_PER_SEC = float(os.environ.get("TPUSIM_MIN_KOPS", "20"))
+MAX_WALL_SECONDS = 60.0
+
+
+def test_fixture_replay_throughput():
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    fd = REPO / "reports" / "silicon"
+    entries = json.loads((fd / "manifest.json").read_text())["workloads"]
+    mods = [
+        select_module(load_trace(fd / e["trace"]), e.get("module"))
+        for e in entries
+    ]
+    eng = Engine(load_config(arch="v5e"))
+    t0 = time.perf_counter()
+    ops = 0
+    for mod in mods:
+        res = eng.run(mod)
+        ops += res.op_count
+    wall = time.perf_counter() - t0
+    assert wall < MAX_WALL_SECONDS, f"replay took {wall:.1f}s"
+    kops = ops / wall / 1e3
+    assert kops > MIN_KOPS_PER_SEC, (
+        f"simulation rate {kops:.1f} kops/s below the {MIN_KOPS_PER_SEC} "
+        f"floor ({ops} ops in {wall:.2f}s) — an engine change cost an "
+        f"order of magnitude of throughput"
+    )

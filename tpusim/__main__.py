@@ -127,6 +127,60 @@ def _cmd_correlate_ops(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_derive_arch(args: argparse.Namespace) -> int:
+    """Cross-generation overlay derivation (docs/V5P.md)."""
+    import os
+
+    from tpusim.timing.derive import derive_overlay
+
+    # default to the directory load_config actually resolves overlays
+    # from ($TPUSIM_TUNED_DIR or <repo>/configs) — a cwd-relative write
+    # from elsewhere would silently never be applied
+    base = os.environ.get("TPUSIM_TUNED_DIR") or str(
+        Path(__file__).resolve().parents[1] / "configs"
+    )
+    out = args.out or str(
+        Path(base) / f"{args.dst.lower()}.derived.flags"
+    )
+    lines = derive_overlay(args.src, args.dst, out_path=out)
+    print(f"derive-arch: {len([l for l in lines if l.startswith('-')])} "
+          f"knobs {args.src} -> {args.dst}, written to {out}")
+    return 0
+
+
+def _cmd_loo(args: argparse.Namespace) -> int:
+    """Leave-one-out validation: the out-of-sample counterpart of the
+    in-sample bench headline (VERDICT r4: 'the train set is the test
+    set').  Writes reports/loo.json."""
+    from tpusim.harness.refine import leave_one_out, load_per_op_rows
+
+    fixture_dir = Path(args.fixtures)
+    manifest_path = fixture_dir / "manifest.json"
+    if not manifest_path.exists():
+        print(f"no fixture manifest at {manifest_path}", file=sys.stderr)
+        return 1
+    manifest = json.loads(manifest_path.read_text())
+    arch = args.arch or manifest.get("arch", "v5e")
+    doc = leave_one_out(
+        arch, manifest.get("workloads", []), fixture_dir,
+        per_op_rows=load_per_op_rows(args.per_op_artifact),
+        max_sweeps=args.sweeps, anchor_weight=args.anchor,
+    )
+    for f in doc["folds"]:
+        print(f"  held-out {f['workload']:24s} "
+              f"err={f['held_out_err_pct']:+8.2f}%  "
+              f"(train objective {f['train_objective']:.2f})")
+    print(f"loo: mean |held-out error| = {doc['mean_loo_abs_err_pct']}% "
+          f"(worst {doc['worst_loo_abs_err_pct']}%) over "
+          f"{len(doc['folds'])} folds")
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(doc, indent=2))
+        print(f"written to {out}")
+    return 0
+
+
 def _cmd_correl_regen(args: argparse.Namespace) -> int:
     """Regenerate the committed per-op correlation artifact offline: the
     CURRENT model replayed against the device durations stored in the
@@ -306,7 +360,7 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     result = refine_arch_on_fixtures(
         arch, manifest.get("workloads", []), fixture_dir,
         base_overlays=seed, max_sweeps=args.sweeps,
-        per_op_rows=per_op_rows,
+        per_op_rows=per_op_rows, anchor_weight=args.anchor,
     )
     if not math.isfinite(result.start_err_pct):
         # no fixture replayed: an "overlay" of untouched preset values
@@ -479,6 +533,38 @@ def main(argv: list[str] | None = None) -> int:
     pco.add_argument("--json", default=None, help="write correl_ops.json")
     pco.set_defaults(fn=_cmd_correlate_ops)
 
+    pda = sub.add_parser(
+        "derive-arch",
+        help="derive a generation's overlay from another's calibration "
+             "(transferable TensorCore knobs over published absolutes)",
+    )
+    pda.add_argument("--src", default="v5e")
+    pda.add_argument("--dst", default="v5p")
+    pda.add_argument("--out", default=None,
+                     help="default: configs/<dst>.derived.flags")
+    pda.set_defaults(fn=_cmd_derive_arch)
+
+    plo = sub.add_parser(
+        "loo",
+        help="leave-one-out validation of the refinement procedure "
+             "(offline; one preset-seeded refit per held-out workload)",
+    )
+    plo.add_argument("--fixtures", default="reports/silicon")
+    plo.add_argument("--arch", default=None)
+    plo.add_argument(
+        "--per-op-artifact", default="reports/correl_ops.json",
+        help="per-op artifact whose device rows join each fold's "
+             "objective (held-out workload excluded)",
+    )
+    plo.add_argument("--sweeps", type=int, default=6)
+    plo.add_argument(
+        "--anchor", type=float, default=1.0,
+        help="quadratic penalty on relative knob drift from the preset "
+             "(physical-prior regularization; 0 disables)",
+    )
+    plo.add_argument("--out", default="reports/loo.json")
+    plo.set_defaults(fn=_cmd_loo)
+
     pcr = sub.add_parser(
         "correl-regen",
         help="regenerate the per-op correlation artifact offline "
@@ -532,6 +618,11 @@ def main(argv: list[str] | None = None) -> int:
     pf.add_argument(
         "--no-per-op", action="store_true",
         help="fit on end-to-end totals only (the pre-round-5 objective)",
+    )
+    pf.add_argument(
+        "--anchor", type=float, default=1.0,
+        help="quadratic penalty on relative knob drift from the seed "
+             "(physical-prior regularization; 0 disables)",
     )
     pf.set_defaults(fn=_cmd_refine)
 

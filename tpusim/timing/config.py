@@ -142,6 +142,21 @@ class ArchConfig:
     # conv2d fixture's HBM->vmem transposing copy ran at 0.42x the
     # same-layout stream bandwidth
     relayout_efficiency: float = 0.45
+    # relayouts that keep the minor (lane) dimension dense in 128-lane
+    # tiles move contiguous 256B+ runs — tile reordering, not element
+    # shuffling — at near-stream rate (decode fixture: a 33.5MB
+    # {4,3,2,1,0}->{4,1,3,2,0} HBM->vmem copy, minor dim 128 on both
+    # sides, achieved 452GB/s = 0.66x pin while conv2d's 64-lane
+    # transposing copy ran at 0.40x)
+    relayout_lane_efficiency: float = 0.66
+    # minimum device cycles for a standalone sub-tile kernel: a bare
+    # slice/DUS of less than a tile, or a scalar-output reduce, still
+    # pays sequencer dispatch + sublane addressing + scalar writeback
+    # (v5e silicon: [1,1] slices 229-567ns, a scalar reduce-fusion
+    # 329ns, a one-row DUS 594ns — while the model's roofline floor is
+    # ~5ns; XLA's own cost model floors the same kernels at ~1830
+    # estimated_cycles)
+    small_kernel_floor_cycles: int = 700
     # vmem->vmem copies stream through load/store ports, not at the full
     # banked vmem bandwidth the roofline uses for fused operand reads
     # (conv2d %copy.11: 6.4MB same-layout vmem copy at 2.4TB/s vs the
@@ -336,7 +351,14 @@ def tuned_overlay_path(arch_name: str) -> Path | None:
         else Path(__file__).resolve().parents[2] / "configs"
     )
     p = base / f"{arch_name.lower()}.tuned.flags"
-    return p if p.is_file() else None
+    if p.is_file():
+        return p
+    # no silicon of this generation was ever measured here: fall back to
+    # the cross-generation derivation (silicon-calibrated transferable
+    # fractions/cycle-counts of the shared TensorCore design applied over
+    # this generation's published absolutes — tpusim.timing.derive)
+    d = base / f"{arch_name.lower()}.derived.flags"
+    return d if d.is_file() else None
 
 
 def load_config(

@@ -385,6 +385,34 @@ def _is_relayout(src: TensorSpec | None, dst: TensorSpec | None) -> bool:
     return src.tiling != dst.tiling
 
 
+def _minor_dim_size(spec: TensorSpec) -> int:
+    """Size of the minor-most (lane) dimension under the buffer's layout
+    (layout tuples are minor-to-major; absent layout = default)."""
+    if not spec.shape:
+        return 0
+    minor = spec.layout[0] if spec.layout else len(spec.shape) - 1
+    if 0 <= minor < len(spec.shape):
+        return int(spec.shape[minor])
+    return 0
+
+
+def _is_lane_preserving_relayout(
+    src: TensorSpec | None, dst: TensorSpec | None,
+) -> bool:
+    """A relayout whose minor dims are dense multiples of the 128-lane
+    tile on BOTH sides only reorders whole tiles (contiguous 256B+ runs
+    for bf16) — it streams near plain-copy rate, unlike a sub-lane
+    shuffle that gathers at element granularity.  A tiling (packing)
+    change shuffles elements WITHIN sublanes regardless of dim sizes —
+    always the slow class."""
+    if src is None or dst is None:
+        return False
+    if src.tiling != dst.tiling:
+        return False
+    s, d = _minor_dim_size(src), _minor_dim_size(dst)
+    return s > 0 and d > 0 and s % 128 == 0 and d % 128 == 0
+
+
 def _is_movement_fusion(module: ModuleTrace, comp_name: str) -> bool:
     """True when a fused computation contains only data-movement ops
     (slice/DUS/concat/copy/...) — it is a DMA-style move, not compute."""
@@ -408,18 +436,43 @@ def _is_movement_fusion(module: ModuleTrace, comp_name: str) -> bool:
     return ok
 
 
-def _fusion_result_region_bytes(called: Computation) -> float | None:
-    """If a fusion's outputs are dynamic-update-slices into big carried
-    buffers (the activation-stash pattern in scanned training loops), the
-    written bytes are the update regions — not the full stacked buffers.
-    Returns the capped write size, or None when the root isn't DUS-shaped."""
+def _fusion_dus_views(
+    called: Computation,
+) -> tuple[float | None, dict[int, float]]:
+    """One walk over a fused computation's root elements producing both
+    DUS-aliasing views:
+
+    * a RESULT write cap — if any output is a dynamic-update-slice into a
+      carried buffer (the activation-stash pattern in scanned training
+      loops), the written bytes are the update region, siblings in a
+      mixed tuple (the lstm cell's ``(stash, h, c)``) their own full
+      size, and parameter pass-throughs zero.  ``None`` when no DUS (and
+      not all-aliased): no cap applies — EXCEPT the all-passthrough case
+      (every element a parameter alias), which caps at 0.0 exactly as it
+      did before DUS handling existed.
+    * PARAM read caps — XLA aliases a DUS's destination operand onto the
+      output: the kernel reads the update region (tile-granular RMW),
+      not the whole carried buffer (lstm: a 128KB update into an 8.4MB
+      carry read +219% before).  A parameter is only capped when ALL its
+      consumers are on the DUS-destination chase chain — a sibling op
+      reading the full buffer (e.g. ``(dus(p0, upd), reduce(p0))``)
+      keeps the full charge."""
     root = called.root
     elements = [root]
     if root.base == "tuple":
         elements = [
             called.op(o) for o in root.operands if called.has_op(o)
         ]
+
+    consumers: dict[str, set[str]] = {}
+    for inner in called.ops:
+        for o in inner.operands:
+            consumers.setdefault(o, set()).add(inner.name)
+
     total = 0.0
+    found_dus = False
+    found_other = False
+    param_caps: dict[int, float] = {}
     for el in elements:
         seen = 0
         while el.base in _CHASE_THROUGH and el.operands and seen < 8:
@@ -428,12 +481,64 @@ def _fusion_result_region_bytes(called: Computation) -> float | None:
             el = called.op(el.operands[0])
             seen += 1
         if el.base == "dynamic-update-slice" and len(el.operands) >= 2:
-            total += _leaf_shape(called, el.operands[1]).nbytes
+            region = float(_leaf_shape(called, el.operands[1]).nbytes)
+            total += region
+            found_dus = True
+            # chase the DUS destination back to the fusion parameter it
+            # aliases (possibly through bitcasts), remembering the chain
+            chain = {el.name}
+            dest = el.operands[0]
+            hops = 0
+            while called.has_op(dest) and hops < 8:
+                dop = called.op(dest)
+                if dop.opcode == "parameter":
+                    try:
+                        idx = int(dop.attrs.get("param_index", ""))
+                    except ValueError:
+                        break
+                    if consumers.get(dop.name, set()) <= chain:
+                        param_caps[idx] = min(
+                            param_caps.get(idx, float("inf")), region
+                        )
+                    break
+                if dop.base in _CHASE_THROUGH and dop.operands:
+                    chain.add(dop.name)
+                    dest = dop.operands[0]
+                    hops += 1
+                else:
+                    break
         elif el.opcode == "parameter":
-            continue  # pass-through, no write
+            continue  # pass-through alias, no write
         else:
-            return None
-    return total
+            # computed output: its own full size (caps to identity when
+            # it stands beside a DUS in a mixed tuple)
+            total += float(sum(l.nbytes for l in leaves_of(el.result)))
+            found_other = True
+    if found_dus or not found_other:
+        return total, param_caps
+    return None, param_caps
+
+
+#: a "small" standalone kernel: moved region under two (8,128) f32 tiles,
+#: or a (near-)scalar result — the classes observed paying a fixed
+#: launch floor on v5e silicon regardless of payload
+_SMALL_KERNEL_REGION_BYTES = 32 * 1024
+_SMALL_KERNEL_RESULT_BYTES = 1024
+
+
+def _is_small_standalone_kernel(op: TraceOp, comp: Computation) -> bool:
+    """Sub-tile data movement (bare slice/DS/DUS) or a (near-)scalar
+    reduce/fusion: kernels whose device duration is dominated by the
+    fixed dispatch floor, not the roofline (v5e: [1,1] slices 229-567ns,
+    scalar reduce-fusion 329ns, one-row DUS 594ns vs a ~5ns roofline)."""
+    if op.base in ("slice", "dynamic-slice", "dynamic-update-slice"):
+        return _region_bytes(comp, op) <= 2.0 * _SMALL_KERNEL_REGION_BYTES
+    if op.base in ("fusion", "reduce"):
+        return (
+            sum(l.nbytes for l in leaves_of(op.result))
+            <= _SMALL_KERNEL_RESULT_BYTES
+        )
+    return False
 
 
 def _memory_bytes(
@@ -456,7 +561,12 @@ def _memory_bytes(
         if op.called[0] in module.computations:
             called = module.computation(op.called[0])
             region_by_index = _fusion_param_region_bytes(called)
-            result_cap = _fusion_result_region_bytes(called)
+            result_cap, dus_caps = _fusion_dus_views(called)
+            for idx, cap in dus_caps.items():
+                prev = region_by_index.get(idx)
+                region_by_index[idx] = (
+                    cap if prev is None else min(prev, cap)
+                )
 
     def account(spec, cap: float | None = None) -> None:
         nonlocal hbm, vmem
@@ -541,6 +651,53 @@ class CostModel:
 
     # -- MXU systolic-pass model ------------------------------------------
 
+    def _normalize_matmul_dtype(
+        self, dt: str, module: "ModuleTrace | None",
+    ) -> str:
+        """Undo the capture backend's float normalization for MXU pricing.
+
+        AOT capture on the CPU mesh (the only option for ahead-of-silicon
+        multi-chip graphs) runs XLA:CPU's FloatNormalization pass, which
+        upcasts every bf16 dot/conv to f32 — pricing those at the f32
+        multi-pass rate (0.25x) read a Llama-7B train step at 3.5% MFU.
+        On TPU the same program keeps bf16 MXU operands with f32
+        accumulation at full rate.  When a CPU-captured module's entry
+        parameters are predominantly sub-f32 (the model's declared
+        compute dtype) and a matmul reads f32, price it at the
+        parameter dtype.  Gated on the capture platform: a TPU-captured
+        trace's f32 dot is a genuine precision choice (e.g. an f32
+        logits matmul) and keeps the f32 multi-pass rate."""
+        if dt != "f32" or module is None:
+            return dt
+        if module.meta.get("platform") not in ("cpu", "interpreter"):
+            return dt
+        cached = getattr(module, "_param_dtype_cache", None)
+        if cached is None:
+            by_dtype: dict[str, float] = {}
+            entry = module.entry if module.entry_name else None
+            if entry is not None:
+                for op in entry.ops:
+                    if op.opcode != "parameter":
+                        continue
+                    for leaf in leaves_of(op.result):
+                        by_dtype[leaf.dtype] = (
+                            by_dtype.get(leaf.dtype, 0.0) + leaf.nbytes
+                        )
+            total = sum(by_dtype.values())
+            major = max(by_dtype, key=by_dtype.get) if by_dtype else ""
+            cached = (
+                major
+                if total > 0 and by_dtype.get(major, 0) > 0.5 * total
+                else ""
+            )
+            try:
+                module._param_dtype_cache = cached
+            except (AttributeError, TypeError):
+                pass
+        if cached in ("bf16", "f16", "bfloat16", "float16"):
+            return cached
+        return dt
+
     def mxu_cycles(self, b: int, m: int, n: int, k: int, dtype: str) -> float:
         """Cycles for a (possibly batched) matmul on the MXU array.
 
@@ -622,11 +779,13 @@ class CostModel:
 
         if base == "dot":
             b, m, n, k, dt = dot_dims(op, comp)
+            dt = self._normalize_matmul_dtype(dt, module)
             c.compute_cycles = self.mxu_cycles(b, m, n, k, dt)
             c.flops = c.mxu_flops = 2.0 * b * m * n * k
             c.unit = Unit.MXU
         elif base == "convolution":
             b, m, n, k, dt = conv_dims(op, comp)
+            dt = self._normalize_matmul_dtype(dt, module)
             c.compute_cycles = self.mxu_cycles(b, m, n, k, dt)
             w = _parse_window(op.attrs.get("window", ""), 0)
             if any(s > 1 for s in w["size"]) and not any(
@@ -706,8 +865,8 @@ class CostModel:
             c.compute_cycles = out_elems / self.arch.vpu_flops_per_cycle
         elif base in DATA_MOVEMENT_OPS:
             c.unit = Unit.DMA
-            if base in ("gather", "scatter"):
-                # scattered rows pay a per-descriptor cost the streaming
+            if base == "gather":
+                # gathered rows pay a per-descriptor cost the streaming
                 # roofline can't see; recorded as compute so the charge
                 # survives fusion aggregation (the gather usually lives
                 # inside a fusion whose memory term is operand-level)
@@ -719,6 +878,32 @@ class CostModel:
                     c.compute_cycles = (
                         rows * float(self.arch.gather_row_overhead_cycles)
                     )
+            elif base == "scatter" and len(op.operands) >= 2:
+                # a scatter's row count is its INDEX count — the result
+                # is the whole table, and pricing a descriptor per table
+                # element made a llama-7b embedding-gradient scatter
+                # read 271ms (should be ~1ms: 16K rows, not 16M elems).
+                # Operand order is (op_0..op_{N-1}, indices,
+                # upd_0..upd_{N-1}), so the indices sit at the midpoint
+                # for ANY variadic arity; verify by integer dtype
+                idx_pos = (len(op.operands) - 1) // 2
+                idx = _leaf_shape(comp, op.operands[idx_pos])
+                if not idx.dtype.startswith(("s", "u")):
+                    for o in op.operands:
+                        cand = _leaf_shape(comp, o)
+                        if cand.dtype.startswith(("s", "u")):
+                            idx = cand
+                            break
+                rows = 1
+                for d in idx.shape:
+                    rows *= max(int(d), 1)
+                if idx.rank >= 2:
+                    # trailing index-vector dim enumerates coordinates
+                    rows //= max(int(idx.shape[-1]), 1)
+                c.compute_cycles = (
+                    max(rows, 1)
+                    * float(self.arch.gather_row_overhead_cycles)
+                )
         elif base == "sort":
             n_el = float(max(out_elems, 2))
             c.flops = n_el * math.log2(n_el) * 4.0
@@ -884,14 +1069,17 @@ class CostModel:
                 c.hbm_bytes = 2.0 * payload
                 c.vmem_bytes = 0.0
             if _is_relayout(src_leaf, dst_leaf):
-                # layout change = physical relayout (tile shuffle), far
-                # below stream rate on both ports (conv2d fixture: 0.42x)
-                c.hbm_rate_scale = min(
-                    c.hbm_rate_scale, a.relayout_efficiency
+                # layout change = physical relayout.  Lane-preserving
+                # relayouts reorder whole tiles at near-stream rate
+                # (decode fixture: 0.66x); sub-lane shuffles gather at
+                # element granularity (conv2d fixture: 0.42x)
+                eff = (
+                    a.relayout_lane_efficiency
+                    if _is_lane_preserving_relayout(src_leaf, dst_leaf)
+                    else a.relayout_efficiency
                 )
-                c.vmem_rate_scale = min(
-                    c.vmem_rate_scale, a.relayout_efficiency
-                )
+                c.hbm_rate_scale = min(c.hbm_rate_scale, eff)
+                c.vmem_rate_scale = min(c.vmem_rate_scale, eff)
         c.hbm_rate_scale = max(c.hbm_rate_scale, 1e-6)
         c.vmem_rate_scale = max(c.vmem_rate_scale, 1e-6)
         c.mem_cycles = max(
@@ -899,6 +1087,14 @@ class CostModel:
             c.vmem_bytes / (a.vmem_bytes_per_cycle * c.vmem_rate_scale),
         )
         c.cycles = a.op_overhead_cycles + max(c.compute_cycles, c.mem_cycles)
+        if (
+            a.small_kernel_floor_cycles > 0
+            and not op.is_async_start
+            and _is_small_standalone_kernel(op, comp)
+        ):
+            # sub-tile standalone kernels pay dispatch + sublane
+            # addressing + scalar writeback regardless of bytes moved
+            c.cycles = max(c.cycles, float(a.small_kernel_floor_cycles))
         c.is_async = op.is_async_start
         if op.opcode in ("copy-start",):
             c.unit = Unit.DMA

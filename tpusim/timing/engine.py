@@ -583,8 +583,13 @@ class Engine:
                     cost.vmem_bytes
                     / (a.vmem_bytes_per_cycle * cost.vmem_rate_scale),
                 )
-                cost.cycles = a.op_overhead_cycles + max(
-                    cost.compute_cycles, cost.mem_cycles
+                # spilling only adds traffic: never below the original
+                # price (which may carry the small-kernel dispatch floor)
+                cost.cycles = max(
+                    cost.cycles,
+                    a.op_overhead_cycles + max(
+                        cost.compute_cycles, cost.mem_cycles
+                    ),
                 )
 
             # ---- collectives -------------------------------------------
@@ -672,9 +677,11 @@ class Engine:
                     cost.vmem_bytes
                     / (a.vmem_bytes_per_cycle * cost.vmem_rate_scale),
                 )
-                new_dur = a.op_overhead_cycles + max(
+                # contention only slows an op down: never below the
+                # uncontended price (which may carry the dispatch floor)
+                new_dur = max(dur, a.op_overhead_cycles + max(
                     cost.compute_cycles, mem_cycles
-                )
+                ))
                 result.hbm_contention_cycles += (
                     max(new_dur - dur, 0.0) + penalty
                 )

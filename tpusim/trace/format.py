@@ -243,6 +243,12 @@ def load_trace(path: str | Path) -> PodTrace:
             # file name is the trace key; HloModule header name may differ
             pod.modules[key] = mod
             mod.meta.setdefault("trace_key", key)
+            # capture-time facts (platform, device_kind) ride on every
+            # module: the cost model gates capture-backend dtype
+            # normalization on the platform the trace came from
+            for k in ("platform", "device_kind"):
+                if k in meta:
+                    mod.meta.setdefault(k, meta[k])
 
     cl = path / "commandlist.jsonl"
     if cl.exists():
