@@ -14,6 +14,15 @@ from tests.conftest import run_in_cpu_mesh
 
 CAPTURE_SCRIPT = r"""
 import json
+import os
+from pathlib import Path
+
+# the flagship path exercises the shipped model: preset + the committed
+# cross-generation derived overlay (docs/V5P.md), not the bare preset
+# the conftest isolation would leave us with (run_in_cpu_mesh children
+# run with cwd = repo root)
+os.environ["TPUSIM_TUNED_DIR"] = str(Path.cwd() / "configs")
+
 from tpusim.models.llama import build_llama_aot
 from tpusim.tracer.capture import capture
 from tpusim.timing.engine import Engine
@@ -54,10 +63,18 @@ def test_llama7b_aot_capture_and_v5p64_sim():
     # ~6 * 6.7e9 params * 16384 tokens / 64 chips ~= 1.0e13
     assert 0.5e13 < r["per_chip_flops"] < 3e13
 
-    # a training step of this size lands in the tens-of-ms to ~1s band on
-    # 64 chips; outside that the model is broken (earlier bugs put it at
-    # 1000x off in both directions)
-    assert 0.02 < r["step_seconds"] < 2.0
+    # defended window (docs/V5P.md): MFU in [9%, 90%] for 2048
+    # tokens/chip with tp8 -> step in [25ms, 250ms].  The lower edge is
+    # the impossible-best bound; the upper edge quantifies the known
+    # CPU-capture bias (f32 fusion buffers, unoverlapped dp all-reduce).
+    # Round-5 regressions this pins: the per-table-element scatter
+    # charge (271ms -> ~1ms) and f32-rate pricing of upcast bf16 dots
+    # (3.5% MFU); either coming back pushes step past 250ms.
+    assert 0.025 < r["step_seconds"] < 0.250, r["step_seconds"]
+
+    peak = 2.0 * 8 * 128 * 128 * 1.75e9 * 64  # v5p-64 bf16 peak
+    mfu = r["per_chip_flops"] * 64 / peak / r["step_seconds"]
+    assert 0.09 < mfu < 0.90, mfu
 
     # collectives must neither be free nor dominate this compute-heavy step
     assert 0 < r["exposed_coll_s"] < r["step_seconds"] * 0.8

@@ -1,0 +1,84 @@
+"""The cross-generation derived overlay (docs/V5P.md).
+
+With no v5p silicon reachable, `tpusim.timing.derive` carries the
+v5e-calibrated transferable knobs (dimensionless fractions +
+cycle counts of the shared TensorCore design) over the v5p preset's
+published absolutes.  Reference slot: per-card tested-cfgs
+(`gpu-simulator/configs/tested-cfgs/`).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_derived_file_is_current(monkeypatch):
+    """configs/v5p.derived.flags must match what the committed v5e
+    overlay derives — a refit that forgets to re-derive fails here."""
+    from tpusim.timing.derive import derive_overlay
+
+    committed = REPO / "configs" / "v5p.derived.flags"
+    assert committed.is_file(), (
+        "missing configs/v5p.derived.flags; run "
+        "`python -m tpusim derive-arch`"
+    )
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(REPO / "configs"))
+    lines = derive_overlay("v5e", "v5p")
+    want = [ln for ln in lines if ln.startswith("-")]
+    have = [
+        ln for ln in committed.read_text().splitlines()
+        if ln.startswith("-")
+    ]
+    assert want == have
+
+
+def test_partition_covers_every_refinable_knob():
+    """Every knob the refiner can move is classified: transferable or
+    explicitly not — an unclassified knob would silently stay at the
+    v5p preset with no recorded justification."""
+    from tpusim.harness.refine import KNOBS
+    from tpusim.timing.derive import (
+        NON_TRANSFERABLE_KNOBS, TRANSFERABLE_KNOBS,
+    )
+
+    classified = set(TRANSFERABLE_KNOBS) | set(NON_TRANSFERABLE_KNOBS)
+    assert set(KNOBS) <= classified, set(KNOBS) - classified
+
+
+def test_derived_overlay_applies_to_v5p(monkeypatch):
+    """load_config('v5p') falls back to the derived overlay when no real
+    v5p.tuned.flags exists, leaving published absolutes untouched."""
+    from tpusim.timing.config import load_config
+
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(REPO / "configs"))
+    cfg = load_config(arch="v5p")
+    base = load_config(arch="v5p", tuned=False)
+    # published absolutes: never derived
+    assert cfg.arch.clock_ghz == base.arch.clock_ghz == 1.75
+    assert cfg.arch.mxu_count == 8
+    assert cfg.arch.hbm_bandwidth == 2765e9
+    # transferable calibration landed (preset default differs)
+    v5e = load_config(arch="v5e")
+    assert cfg.arch.hbm_efficiency == pytest.approx(
+        v5e.arch.hbm_efficiency
+    )
+    assert cfg.arch.op_overhead_cycles == v5e.arch.op_overhead_cycles
+
+
+def test_real_tuned_overlay_beats_derived(monkeypatch, tmp_path):
+    """A real <arch>.tuned.flags must shadow the derived fallback."""
+    from tpusim.timing.config import load_config, tuned_overlay_path
+
+    cfgdir = tmp_path / "configs"
+    cfgdir.mkdir()
+    (cfgdir / "v5p.derived.flags").write_text("-arch.hbm_efficiency 0.5\n")
+    monkeypatch.setenv("TPUSIM_TUNED_DIR", str(cfgdir))
+    assert tuned_overlay_path("v5p").name == "v5p.derived.flags"
+    assert load_config(arch="v5p").arch.hbm_efficiency == 0.5
+    (cfgdir / "v5p.tuned.flags").write_text("-arch.hbm_efficiency 0.9\n")
+    assert tuned_overlay_path("v5p").name == "v5p.tuned.flags"
+    assert load_config(arch="v5p").arch.hbm_efficiency == 0.9
