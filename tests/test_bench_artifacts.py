@@ -284,3 +284,40 @@ def test_refine_and_validate_refuses_empty_fixture_set(
     assert rows is None
     assert "refined" not in tuned_info
     assert (REPO_ROOT / tuned_info["overlay"]).read_text() == seed_text
+
+
+@pytest.mark.skipif(
+    not (REPO_ROOT / "reports" / "silicon" / "manifest.json").exists(),
+    reason="no committed silicon fixtures",
+)
+def test_refine_excludes_held_out_entries(tmp_path, monkeypatch):
+    """Held-out full-model fixtures (VERDICT r4 #2) must never steer the
+    refit: an entry flagged held_out with an absurd real_seconds would
+    wreck the fit if trained on — the fit must come out identical to one
+    without it."""
+    bench, tuned_info, entries = _seed_overlay(tmp_path, monkeypatch)
+    poisoned = list(entries) + [{
+        "name": "matmul_chain",  # rows exist for it in the artifact
+        "trace": "matmul_chain", "n_steps": 16,
+        "real_seconds": 1.0,  # absurd: 1 s/step vs the real 390us
+        "held_out": True,
+    }]
+    seed_text = (REPO_ROOT / tuned_info["overlay"]).read_text()
+    try:
+        bench.refine_and_validate(
+            tuned_info, poisoned, "TPU v5 lite",
+            fixture_dir=REPO_ROOT / "reports" / "silicon",
+        )
+    finally:
+        # restore the seed: the run rewrites the overlay in place, and
+        # the comparison needs both fits to start from the same point
+        (REPO_ROOT / tuned_info["overlay"]).write_text(seed_text)
+    assert tuned_info.get("refined"), "poisoned-run refine did not run"
+    clean_info = {"overlay": tuned_info["overlay"], "fit": {}}
+    bench.refine_and_validate(
+        clean_info, entries, "TPU v5 lite",
+        fixture_dir=REPO_ROOT / "reports" / "silicon",
+    )
+    assert (
+        tuned_info["refined"]["changed"] == clean_info["refined"]["changed"]
+    ), "held-out entry leaked into the training objective"

@@ -161,9 +161,17 @@ def _cmd_loo(args: argparse.Namespace) -> int:
         return 1
     manifest = json.loads(manifest_path.read_text())
     arch = args.arch or manifest.get("arch", "v5e")
+    from tpusim.harness.refine import split_held_out
+
+    # held-out full-model fixtures are already out-of-sample by
+    # construction; LOO folds cover the training workloads only
+    entries, per_op_rows, _ = split_held_out(
+        manifest.get("workloads", []),
+        load_per_op_rows(args.per_op_artifact),
+    )
     doc = leave_one_out(
-        arch, manifest.get("workloads", []), fixture_dir,
-        per_op_rows=load_per_op_rows(args.per_op_artifact),
+        arch, entries, fixture_dir,
+        per_op_rows=per_op_rows,
         max_sweeps=args.sweeps, anchor_weight=args.anchor,
     )
     for f in doc["folds"]:
@@ -188,9 +196,10 @@ def _cmd_correl_regen(args: argparse.Namespace) -> int:
     rejects a stale committed artifact by model-version stamp)."""
     from tpusim.harness.correl_ops import regenerate_offline
 
+    out = args.out or args.artifact
     doc = regenerate_offline(
         args.artifact, fixture_dir=args.fixtures, arch=args.arch,
-        out_path=args.out or args.artifact,
+        out_path=out,
     )
     print(
         f"correl-regen: {len(doc['workloads'])} workloads, "
@@ -198,8 +207,29 @@ def _cmd_correl_regen(args: argparse.Namespace) -> int:
         f"{doc['mean_sync_weighted_abs_error_pct']}% "
         f"(all rows {doc['mean_weighted_abs_error_pct']}%), "
         f"model_version {doc['model_version']} "
-        f"-> {args.out or args.artifact}"
+        f"-> {out}"
     )
+    # the async-observable demonstration derives purely from the per-op
+    # artifact + manifest; keep it in lockstep with the regen
+    try:
+        from tpusim.harness.async_observable import (
+            analyze_async_observable,
+        )
+
+        demo = analyze_async_observable(
+            out, Path(args.fixtures) / "manifest.json",
+            fixture_dir=args.fixtures, arch=args.arch,
+        )
+        demo_path = Path(out).parent / "async_observable.json"
+        demo_path.write_text(json.dumps(demo, indent=2))
+        print(
+            f"correl-regen: async-observable evidence refreshed "
+            f"({demo['evidence']['occupancy_impossible_rows']} "
+            f"occupancy-impossible rows) -> {demo_path}"
+        )
+    except Exception as e:
+        print(f"correl-regen: async evidence FAILED: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
     return 0
 
 
@@ -354,11 +384,15 @@ def _cmd_refine(args: argparse.Namespace) -> int:
     manifest = json.loads(manifest_path.read_text())
     arch = args.arch or manifest.get("arch", "v5e")
     seed = [args.seed] if args.seed else []
-    per_op_rows = (
-        {} if args.no_per_op else load_per_op_rows(args.per_op_artifact)
+    # held-out full-model fixtures are validation, never training
+    from tpusim.harness.refine import split_held_out
+
+    train_entries, per_op_rows, _ = split_held_out(
+        manifest.get("workloads", []),
+        {} if args.no_per_op else load_per_op_rows(args.per_op_artifact),
     )
     result = refine_arch_on_fixtures(
-        arch, manifest.get("workloads", []), fixture_dir,
+        arch, train_entries, fixture_dir,
         base_overlays=seed, max_sweeps=args.sweeps,
         per_op_rows=per_op_rows, anchor_weight=args.anchor,
     )

@@ -28,7 +28,27 @@ from typing import Any, Callable
 __all__ = [
     "RefineResult", "KNOBS", "refine", "refine_arch_on_fixtures",
     "load_per_op_rows", "leave_one_out", "replay_errors_with_values",
+    "split_held_out",
 ]
+
+
+def split_held_out(
+    entries: list[dict],
+    per_op_rows: dict[str, list[dict]] | None = None,
+) -> tuple[list[dict], dict[str, list[dict]], list[dict]]:
+    """(train_entries, train_per_op_rows, held_out_entries).
+
+    THE one place that enforces the out-of-sample invariant: manifest
+    entries flagged ``held_out`` (the full-model validation workloads,
+    VERDICT r4 #2) never reach a fit — neither their totals nor their
+    per-op device rows."""
+    train = [e for e in entries if not e.get("held_out")]
+    held = [e for e in entries if e.get("held_out")]
+    names = {e.get("name", e.get("trace", "?")) for e in train}
+    rows = {
+        k: v for k, v in (per_op_rows or {}).items() if k in names
+    }
+    return train, rows, held
 
 
 def load_per_op_rows(artifact_path: str | Path) -> dict[str, list[dict]]:

@@ -190,6 +190,8 @@ def _build(batch, image, num_classes, dtype, num_devices, train):
     num_devices=1, train=False,
 )
 def build_resnet50(**kw):
+    # num_devices rides the Workload record, not params (registry.py:44)
+    kw.setdefault("num_devices", 1)
     return _build(**kw)
 
 
@@ -201,6 +203,7 @@ def build_resnet50(**kw):
     num_devices=1, train=True,
 )
 def build_resnet50_train(**kw):
+    kw.setdefault("num_devices", 1)
     return _build(**kw)
 
 
