@@ -66,6 +66,11 @@ SUITE = [
     ("relayout_copy", {"rows": 4096, "cols": 4096}, 32),
     # quantized serving: first silicon measurement of the s8 dtype_mult
     ("matmul_int8", {"m": 4096, "n": 4096, "k": 4096}, 16),
+    # the two reduce regimes decode_step exposed (round-5): wide-lane
+    # tree combine (extrapolated, never measured) and major-dim serial
+    # accumulation (reads -56% inside decode's context fusion)
+    ("reduce_lane_wide", {"rows": 65536, "cols": 1024}, 32),
+    ("reduce_major_acc", {"rows": 1024, "cols": 8192}, 32),
 ]
 
 # FULL-MODEL steps, measured and reported but NEVER given to the refiner

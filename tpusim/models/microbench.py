@@ -414,3 +414,51 @@ def build_matmul_int8(m: int, n: int, k: int):
         )
 
     return f, (a, b)
+
+
+@register(
+    "reduce_lane_wide",
+    description="bf16 reduce over a WIDE minor (lane) dim — extent 1024 "
+    "crosses 8 lane tiles; pins the tree-combine factor of the "
+    "lane-cross reduce model (currently an extrapolation: the decode "
+    "fixture only exercises extent 128)",
+    suite="ubench",
+    rows=65536, cols=1024,
+)
+def build_reduce_lane_wide(rows: int, cols: int):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16
+    )
+
+    def f(x):
+        return jnp.sum(x, axis=-1)
+
+    return f, (x,)
+
+
+@register(
+    "reduce_major_acc",
+    description="bf16 accumulate over the MAJOR dim (decode fusion.52 "
+    "regime: serial tile accumulation, no lane crossing) — the decode "
+    "fixture's context-reduce reads -56% and no committed ubench "
+    "isolates the serial-accumulate rate",
+    suite="ubench",
+    rows=1024, cols=8192,
+)
+def build_reduce_major_acc(rows: int, cols: int):
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16
+    )
+
+    def f(x):
+        # reduce dim 0 (major under default {1,0} layout): each step
+        # accumulates a full (8,128)-tile row — the fusion.52 pattern
+        return jnp.sum(x, axis=0)
+
+    return f, (x,)

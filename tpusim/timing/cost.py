@@ -851,8 +851,25 @@ class CostModel:
                         else max(spec.rank - 1, 0)
                     )
                     if minor in dims:
+                        # lane-dim reduce: within-tile lane shuffle
+                        # (decode fixture, extent 128: ~0.7 cy/output),
+                        # plus one tree-combine step per doubling of the
+                        # lane TILES crossed.  The tree term is the
+                        # standard reduction-tree extrapolation — no
+                        # committed fixture row exercises extent > 128
+                        # yet; the reduce_lane_wide ubench exists to pin
+                        # it on the next live run
+                        lanes = max(int(self.arch.vpu_lanes), 1)
+                        extent = (
+                            spec.shape[minor]
+                            if minor < len(spec.shape) else lanes
+                        )
+                        tiles = max(1, -(-int(extent) // lanes))
+                        factor = 1.0 + math.ceil(math.log2(tiles))
                         c.compute_cycles += (
-                            out_elems * self.arch.vpu_lane_cross_cycles
+                            out_elems
+                            * self.arch.vpu_lane_cross_cycles
+                            * factor
                         )
             util = self._vpu_util(
                 _leaf_shape(comp, op.operands[0]) if op.operands else None
