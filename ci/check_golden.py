@@ -10,8 +10,9 @@ tight-relative for derived floats; any diff means the timing model changed
 — rerun with ``--update`` when the change is intended.
 
 Usage:
-    python ci/check_golden.py            # check
-    python ci/check_golden.py --update   # regenerate goldens
+    python ci/check_golden.py              # check stats
+    python ci/check_golden.py --update     # regenerate goldens
+    python ci/check_golden.py --obs-smoke  # obs-export schema smoke
 """
 
 from __future__ import annotations
@@ -115,11 +116,62 @@ def compare(
     return errors
 
 
+#: the obs smoke fixture: the multi-device golden trace, replayed with
+#: the observability layer on and its exports schema-checked
+OBS_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+OBS_SCHEMA = REPO / "ci" / "obs_schema.json"
+
+
+def obs_smoke(out_dir: Path | None = None) -> dict:
+    """Simulate one golden fixture with ``--obs-out`` semantics and
+    validate the emitted JSONL/trace/prometheus set against the
+    checked-in schema (``ci/obs_schema.json``).  Raises on violation."""
+    import tempfile
+
+    from tpusim.obs import Instrumentation, validate_obs_dir, write_obs_dir
+    from tpusim.sim.driver import simulate_trace
+
+    schema = json.loads(OBS_SCHEMA.read_text())
+    tmp = None
+    if out_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="tpusim_obs_smoke_")
+        out_dir = Path(tmp.name)
+    try:
+        obs = Instrumentation()
+        report = simulate_trace(
+            FIXTURES / OBS_SMOKE_FIXTURE, arch="v5p", tuned=False, obs=obs,
+        )
+        write_obs_dir(out_dir, report, obs=obs)
+        summary = validate_obs_dir(out_dir, schema)
+        # the self-profiling side must have seen the pipeline phases
+        for phase in ("parse", "simulate", "simulate/engine"):
+            if phase not in obs.spans:
+                raise ValueError(f"obs smoke: span {phase!r} not recorded")
+        return summary
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
                     help="rewrite ci/golden/ from the current model")
+    ap.add_argument("--obs-smoke", action="store_true",
+                    help="validate the obs export set against "
+                         "ci/obs_schema.json instead of checking stats")
     args = ap.parse_args(argv)
+
+    if args.obs_smoke:
+        try:
+            summary = obs_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --obs-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --obs-smoke: OK ({summary['windows']} "
+              f"windows, counter tracks {summary['counter_tracks']}, "
+              f"{summary['gauges']} prometheus gauges)")
+        return 0
 
     got = run_matrix()
     if args.update:

@@ -10,28 +10,34 @@
 #   3. golden  — simulate committed fixture traces across a config matrix,
 #                diff every stat against ci/golden/ (the prebuilt-trace
 #                regression sims)
-#   4. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#   4. obs     — simulate a golden fixture with the observability layer
+#                on; validate the emitted samples JSONL / Chrome trace /
+#                prometheus text against ci/obs_schema.json
+#   5. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-3
+# Usage:  bash ci/run_ci.sh            # tiers 1-4
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/4] build native ==="
+echo "=== [1/5] build native ==="
 make -C native
 
-echo "=== [2/4] unit tests (fast tier) ==="
+echo "=== [2/5] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [3/4] golden-stat regression sims ==="
+echo "=== [3/5] golden-stat regression sims ==="
 python ci/check_golden.py
 
+echo "=== [4/5] obs export smoke (schema-checked) ==="
+python ci/check_golden.py --obs-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [4/4] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [5/5] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [4/4] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [5/5] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -301,3 +301,141 @@ ENTRY %main (t: bf16[131072,1024], ids: s32[8192]) -> bf16[8192,1024] {
     # region scoping still caps the memory side at the moved rows
     full = cm.op_cost(comp.op("g"), comp, mod)
     assert full.hbm_bytes <= 2 * 2 * 8192 * 1024 + 8192 * 4
+
+
+def _scatter_text(idx_shape: str, attrs: str) -> str:
+    return f"""
+HloModule s, is_scheduled=true
+
+%add_f32 (a: f32[], b: f32[]) -> f32[] {{
+  %a = f32[]{{:T(128)}} parameter(0)
+  %b = f32[]{{:T(128)}} parameter(1)
+  ROOT %r = f32[]{{:T(128)}} add(%a, %b)
+}}
+
+ENTRY %main (t: f32[16384,256], ids: {idx_shape}, upd: f32[1024,256]) -> f32[16384,256] {{
+  %t = f32[16384,256]{{1,0}} parameter(0)
+  %ids = {idx_shape} parameter(1)
+  %upd = f32[1024,256]{{1,0}} parameter(2)
+  ROOT %s = f32[16384,256]{{1,0}} scatter(%t, %ids, %upd), {attrs}, to_apply=%add_f32
+}}
+"""
+
+
+def test_scatter_rows_use_index_vector_dim(v5p_cfg):
+    """The scatter descriptor count divides out the dimension
+    ``index_vector_dim`` names, not blindly the trailing one (a
+    leading-coordinate layout would undercount rows 1024/3 -> 341)."""
+    cm = CostModel(v5p_cfg.arch)
+
+    # coordinate vectors on the LEADING dim: s32[2,1024] with
+    # index_vector_dim=0 is 1024 rows of 2-coordinates each
+    mod = parse_hlo_module(_scatter_text(
+        "s32[2,1024]{1,0}",
+        "update_window_dims={1}, inserted_window_dims={0}, "
+        "scatter_dims_to_operand_dims={0}, index_vector_dim=0",
+    ))
+    comp = mod.entry
+    c = cm._compute_cost(comp.op("s"), comp, mod)
+    assert c.compute_cycles == pytest.approx(
+        1024 * v5p_cfg.arch.gather_row_overhead_cycles
+    )
+
+    # index_vector_dim == rank: every element is a scalar row index —
+    # nothing is divided out (s32[1024] -> 1024 rows)
+    mod = parse_hlo_module(_scatter_text(
+        "s32[1024]{0}",
+        "update_window_dims={1}, inserted_window_dims={0}, "
+        "scatter_dims_to_operand_dims={0}, index_vector_dim=1",
+    ))
+    comp = mod.entry
+    c = cm._compute_cost(comp.op("s"), comp, mod)
+    assert c.compute_cycles == pytest.approx(
+        1024 * v5p_cfg.arch.gather_row_overhead_cycles
+    )
+
+    # attr absent: the trailing-dim fallback still applies (rank >= 2)
+    mod = parse_hlo_module(_scatter_text(
+        "s32[1024,1]{1,0}",
+        "update_window_dims={1}, inserted_window_dims={0}, "
+        "scatter_dims_to_operand_dims={0}",
+    ))
+    comp = mod.entry
+    c = cm._compute_cost(comp.op("s"), comp, mod)
+    assert c.compute_cycles == pytest.approx(
+        1024 * v5p_cfg.arch.gather_row_overhead_cycles
+    )
+
+
+# -- DUS param-read cap: the whole chase chain must be private ---------------
+
+_DUS_SIBLING_READER_TEXT = """HloModule m, is_scheduled=true
+
+%fused (param_0: bf16[4096,1024], param_1: bf16[1,1024], param_2: s32[]) -> (bf16[4096,1024], bf16[]) {
+  %param_0 = bf16[4096,1024]{1,0:T(8,128)(2,1)} parameter(0)
+  %param_1 = bf16[1,1024]{1,0:T(8,128)(2,1)} parameter(1)
+  %param_2 = s32[]{:T(128)} parameter(2)
+  %zero = s32[]{:T(128)} constant(0)
+  %view = bf16[4096,1024]{1,0:T(8,128)(2,1)} bitcast(%param_0)
+  %red = bf16[]{:T(128)} bitcast(%view)
+  %dus = bf16[4096,1024]{1,0:T(8,128)(2,1)} dynamic-update-slice(%view, %param_1, %param_2, %zero)
+  ROOT %t = (bf16[4096,1024]{1,0:T(8,128)(2,1)}, bf16[]{:T(128)}) tuple(%dus, %red)
+}
+
+ENTRY %main (p0: bf16[4096,1024], p1: bf16[1,1024], p2: s32[]) -> (bf16[4096,1024], bf16[]) {
+  %p0 = bf16[4096,1024]{1,0:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[1,1024]{1,0:T(8,128)(2,1)} parameter(1)
+  %p2 = s32[]{:T(128)} parameter(2)
+  ROOT %c = (bf16[4096,1024]{1,0:T(8,128)(2,1)}, bf16[]{:T(128)}) fusion(%p0, %p1, %p2), kind=kLoop, calls=%fused
+}
+"""
+
+
+def test_dus_param_cap_blocked_by_chain_sibling_reader(v5p_cfg):
+    """An intermediate view on the DUS destination chase chain that also
+    feeds a sibling op means the kernel reads the FULL carried buffer;
+    the param-read cap must not apply (before the fix only the
+    parameter's own consumers were checked, so a bitcast feeding both
+    the DUS and a reduce still capped the read at the update region)."""
+    cm = CostModel(v5p_cfg.arch)
+    mod = parse_hlo_module(_DUS_SIBLING_READER_TEXT)
+    comp = mod.entry
+    cost = cm.op_cost(comp.op("c"), comp, mod)
+    full = 4096 * 1024 * 2  # the carried bf16 buffer
+    # the full carry is read through %red's view: traffic must be at
+    # least one full-buffer read, not the ~2KB update region
+    assert cost.hbm_bytes + cost.vmem_bytes >= full
+
+
+def test_small_kernel_floor_band_is_floored(v5p_cfg):
+    """The dispatch floor binds through the whole <=32KB-region band
+    (cost.py _SMALL_KERNEL_REGION_BYTES; the 2x at the use site mirrors
+    _region_bytes' read+write doubling): a 24KB-region slice prices at
+    the floor, a 1MB-region slice at its (larger) roofline."""
+    from tpusim.timing.config import SimConfig
+
+    cfg = SimConfig()
+    a = cfg.arch
+    floor = a.small_kernel_floor_cycles
+    assert floor > 0
+    cm = CostModel(a)
+
+    def slice_cost(rows: int) -> float:
+        text = f"""
+HloModule m, is_scheduled=true
+
+ENTRY %main (p0: f32[131072,128]) -> f32[{rows},128] {{
+  %p0 = f32[131072,128]{{1,0:T(8,128)}} parameter(0)
+  ROOT %c = f32[{rows},128]{{1,0:T(8,128)}} slice(%p0), slice={{[0:{rows}], [0:128]}}
+}}
+"""
+        mod = parse_hlo_module(text)
+        comp = mod.entry
+        return cm.op_cost(comp.op("c"), comp, mod).cycles
+
+    in_band = slice_cost(48)      # 48*128*4 = 24KB region
+    assert in_band >= floor
+    big = slice_cost(2048)        # 1MB region: roofline-priced
+    roofline = 2.0 * 2048 * 128 * 4 / a.hbm_bytes_per_cycle
+    assert big >= roofline
+    assert big > in_band  # the floor never lowers a roofline price

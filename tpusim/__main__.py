@@ -34,9 +34,39 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         overlays.append({"checkpoint_op": args.checkpoint_op})
     if args.network_mode:
         overlays.append({"arch": {"ici": {"network_mode": args.network_mode}}})
-    report = simulate_trace(args.trace, arch=args.arch, overlays=overlays)
+    obs = None
+    if args.obs_window_cycles and not args.obs_out:
+        print("tpusim: error: --obs-window-cycles requires --obs-out "
+              "(nothing would be sampled or written)", file=sys.stderr)
+        return 2
+    if args.obs_out:
+        from tpusim.obs import Instrumentation
+
+        obs = Instrumentation(window_cycles=args.obs_window_cycles)
+    report = simulate_trace(
+        args.trace, arch=args.arch, overlays=overlays, obs=obs
+    )
     if args.power and report.power is not None:
         print(report.power.report_text())
+    if obs is not None:
+        from tpusim.obs import write_obs_dir
+
+        with obs.span("export"):
+            paths = write_obs_dir(args.obs_out, report, obs=obs)
+        n_win = report.samples.num_windows if report.samples else 0
+        w_cyc = report.samples.window_cycles if report.samples else 0
+        print(f"obs: {n_win} windows x {w_cyc:.0f} cycles -> "
+              + ", ".join(str(p) for p in paths.values()))
+        s = report.samples
+        if s is not None and s.pinned and s.coarsenings:
+            print(f"obs: warning: requested window "
+                  f"{args.obs_window_cycles:.0f} cycles exceeded the "
+                  f"{s.max_windows}-window memory cap; coarsened "
+                  f"{2 ** s.coarsenings}x to {s.window_cycles:.0f} cycles",
+                  file=sys.stderr)
+        # refresh the obs stats snapshot now that the simulate/export
+        # spans have closed (the driver snapshotted mid-span)
+        report.stats.update(obs.stats_dict(), prefix="obs_")
     report.print_report()
     if args.json:
         with open(args.json, "w") as f:
@@ -90,6 +120,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         capture_missing=args.capture,
         parallel=args.parallel,
         power=args.power,
+        obs=args.obs,
         monitor_interval_s=args.monitor_interval,
     )
     failed = rows.get("__failed__", {}).get("runs", [])
@@ -233,6 +264,59 @@ def _cmd_correl_regen(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_profile(args: argparse.Namespace) -> int:
+    """Pipeline self-profiling — where does the SIMULATOR spend host
+    time (the breakdown behind the ``silicon_slowdown`` headline): a
+    per-phase wall-clock/peak-RSS table from the obs span tree, plus the
+    top-N costliest simulated ops."""
+    import time
+
+    from tpusim.obs import Instrumentation
+
+    t_enter = time.perf_counter()
+    # sample=False: the point is the breakdown of a NORMAL simulation's
+    # host time — per-op sampler feeds would skew the very table printed
+    obs = Instrumentation(sample=False)
+    with obs.span("init"):
+        from tpusim.sim.driver import simulate_trace
+
+    report = simulate_trace(args.trace, arch=args.arch, obs=obs)
+
+    with obs.span("report"):
+        totals = report.totals
+        op_rows = sorted(
+            totals.per_op_cycles.items(), key=lambda kv: -kv[1]
+        )[:args.top]
+    total_wall = time.perf_counter() - t_enter
+
+    arch = report.arch_config
+    print(f"tpusim profile: {args.trace}")
+    print(f"  arch={report.config_name} devices={report.num_devices} "
+          f"kernels={len(report.kernels)} sim_cycles={report.cycles:.4g}")
+    print(f"  wall={total_wall:.3f}s sim_rate={report.sim_rate_kops:.1f} "
+          f"kops/s silicon_slowdown="
+          f"{report.silicon_slowdown(arch.clock_hz):.3g}")
+    print()
+    for line in obs.profile_lines(total_wall):
+        print(line)
+    print()
+    print(f"top {len(op_rows)} costliest ops "
+          f"(of {totals.op_count} simulated):")
+    print(f"  {'op':40s} {'opcode':18s} {'cycles':>12s} "
+          f"{'count':>8s} {'% cycles':>9s}")
+    # per_op_cycles accumulates across every launch on every replayed
+    # device, so normalize by total device-time, not the pod makespan
+    # (with the makespan a 4-device SPMD op would print >100%)
+    device_time = sum(report.device_cycles.values()) or report.cycles
+    for name, cyc in op_rows:
+        opcode = totals.per_op_opcode.get(name, "?")
+        count = totals.per_op_count.get(name, 0.0)
+        pct = 100.0 * cyc / device_time if device_time else 0.0
+        print(f"  {name[:40]:40s} {opcode[:18]:18s} {cyc:12.4g} "
+              f"{count:8.0f} {pct:8.2f}%")
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     from tpusim.trace.format import load_trace
 
@@ -281,13 +365,31 @@ def _cmd_timeline(args: argparse.Namespace) -> int:
     from tpusim.timing.engine import Engine
     from tpusim.trace.format import load_trace
 
+    if args.obs_window_cycles and not args.counters:
+        print("tpusim: error: --obs-window-cycles requires --counters",
+              file=sys.stderr)
+        return 2
     pod = load_trace(args.trace)
     mod = _pick_module(pod, args.module)
     cfg = load_config(arch=args.arch)
-    res = Engine(cfg, record_timeline=True).run(mod)
-    write_chrome_trace(res, cfg.arch, args.out, process_name=mod.name)
-    print(f"chrome trace ({len(res.timeline)} events) written to {args.out}; "
-          f"open in chrome://tracing or ui.perfetto.dev")
+    obs = None
+    if args.counters:
+        from tpusim.obs import Instrumentation
+
+        obs = Instrumentation(window_cycles=args.obs_window_cycles)
+    res = Engine(cfg, record_timeline=True, obs=obs).run(mod)
+    extra = None
+    if obs is not None and res.samples is not None:
+        from tpusim.obs import counter_track_events, window_rows
+
+        rows = window_rows(res.samples, cfg.arch)
+        extra = counter_track_events(rows, cfg.arch.clock_hz)
+    write_chrome_trace(
+        res, cfg.arch, args.out, process_name=mod.name, extra_events=extra
+    )
+    n_extra = f" + {len(extra)} counter samples" if extra else ""
+    print(f"chrome trace ({len(res.timeline)} events{n_extra}) written to "
+          f"{args.out}; open in chrome://tracing or ui.perfetto.dev")
     return 0
 
 
@@ -520,6 +622,14 @@ def main(argv: list[str] | None = None) -> int:
                     choices=["analytic", "detailed"],
                     help="ICI model: closed-form schedules or per-packet "
                          "torus network sim (the -network_mode equivalent)")
+    ps.add_argument("--obs-out", default=None, metavar="DIR",
+                    help="enable the observability layer and write "
+                         "samples.jsonl + trace.json (Perfetto counter "
+                         "tracks) + metrics.prom here")
+    ps.add_argument("--obs-window-cycles", type=float, default=0.0,
+                    help="cycle-window size for the sampler "
+                         "(0 = auto: self-coarsening to a bounded "
+                         "window count)")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")
@@ -553,6 +663,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="capture missing traces on the live backend first")
     pr.add_argument("--parallel", type=int, default=None)
     pr.add_argument("--power", action="store_true")
+    pr.add_argument("--obs", action="store_true",
+                    help="write per-run obs exports (samples.jsonl, "
+                         "trace.json, metrics.prom) under each run dir")
     pr.add_argument("--monitor-interval", type=float, default=10.0)
     pr.set_defaults(fn=_cmd_run)
 
@@ -610,6 +723,17 @@ def main(argv: list[str] | None = None) -> int:
     pcr.add_argument("--out", default=None,
                      help="output path (default: overwrite --artifact)")
     pcr.set_defaults(fn=_cmd_correl_regen)
+
+    pp = sub.add_parser(
+        "profile",
+        help="self-profile one replay: per-phase wall-clock/peak-RSS "
+             "table (parse/cost/engine/ici/power) + top costliest ops",
+    )
+    pp.add_argument("trace")
+    pp.add_argument("--arch", default=None)
+    pp.add_argument("--top", type=int, default=10,
+                    help="how many costliest ops to print")
+    pp.set_defaults(fn=_cmd_profile)
 
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")
@@ -690,6 +814,10 @@ def main(argv: list[str] | None = None) -> int:
     pv.add_argument("out")
     pv.add_argument("--module", default=None)
     pv.add_argument("--arch", default=None)
+    pv.add_argument("--counters", action="store_true",
+                    help="merge sampled counter tracks (mxu_util, "
+                         "hbm_gbps, ...) into the trace")
+    pv.add_argument("--obs-window-cycles", type=float, default=0.0)
     pv.set_defaults(fn=_cmd_timeline)
 
     pa = sub.add_parser(

@@ -39,6 +39,7 @@ class RunSpec:
     overlays: list[str] = field(default_factory=list)   # flag-file lines
     name: str | None = None
     power: bool = False
+    obs: bool = False           # per-run obs exports under <run_dir>/obs/
 
     @property
     def run_name(self) -> str:
@@ -126,6 +127,10 @@ def run_experiments(
         ]
         if spec.power:
             cmd.append("--power")
+        if spec.obs:
+            # per-run time series + prometheus text land beside the log,
+            # scrapeable like the stats JSON
+            cmd += ["--obs-out", str(run_dir / "obs")]
         pm.submit(cmd, log_path=run_dir / "run.log")
     on_tick = _monitor_printer(monitor_interval_s) if monitor_interval_s \
         else None
@@ -162,6 +167,7 @@ def run_suite(
     capture_missing: bool = False,
     parallel: int | None = None,
     power: bool = False,
+    obs: bool = False,
     timeout_s: float | None = 1800,
     monitor_interval_s: float | None = 10.0,
 ) -> dict[str, dict[str, object]]:
@@ -227,6 +233,7 @@ def run_suite(
                 overlays=lines,
                 name=f"{e.run_name}__{extra}" if extra else e.run_name,
                 power=power,
+                obs=obs,
             ))
     return run_experiments(
         specs, out_root, parallel=parallel, timeout_s=timeout_s,

@@ -301,10 +301,22 @@ def _merge_phase_lists(
 class DetailedCollectiveModel:
     """Same ``seconds(info, payload)`` interface as the analytic
     :class:`~tpusim.ici.collectives.CollectiveModel`, but every schedule is
-    replayed packet-by-packet on a :class:`TorusNetwork`."""
+    replayed packet-by-packet on a :class:`TorusNetwork`.
+
+    ``obs`` (a :class:`tpusim.obs.hub.Instrumentation`) turns on link
+    accounting, recorded once per ``seconds()`` PRICING CALL — which is
+    once per unique module for kernel-internal collectives (the driver
+    caches engine results per module) and once per participating device
+    command for standalone ones.  The absolute counters therefore do not
+    scale with run-level launch counts; consume them as the
+    busy/capacity RATIO (``ici.detailed.link_busy_cycles`` /
+    ``ici.detailed.link_cycle_capacity``), a pricing-weighted mean link
+    occupancy, which is what the schedule-level view can support.  The
+    run-scaled time series lives in the pod sampler's ``ici`` lane."""
 
     topo: Topology
     cfg: "IciConfig"
+    obs: object | None = None
 
     def __post_init__(self):
         # link moves (bandwidth * efficiency) bytes/sec; at the 1 GHz
@@ -536,6 +548,8 @@ class DetailedCollectiveModel:
         cycles = self.net.run_phases(
             phases, packet_bytes=self.cfg.packet_bytes
         )
+        if self.obs is not None and getattr(self.obs, "enabled", False):
+            self._record_link_occupancy(info, phases, cycles)
         t = self.cfg.launch_latency + cycles * NET_CYCLE_S
         n = max(info.group_size, 1)
         if 0 < self.cfg.chips_per_slice < n:
@@ -544,13 +558,42 @@ class DetailedCollectiveModel:
             t = max(t, self._analytic.seconds(info, payload_bytes))
         return t
 
+    def _record_link_occupancy(
+        self, info: CollectiveInfo, phases, cycles: float
+    ) -> None:
+        """Feed the obs hub with per-PRICING-CALL link accounting: each
+        transfer serializes ``bytes/flit_bytes`` cycles onto every
+        directed link of its route, so summed link-busy over the touched
+        links' cycle capacity is the schedule's achieved occupancy (the
+        per-link view the analytic model's closed forms can't see).
+        See the class docstring for the multiplicity caveat — only the
+        busy/capacity ratio is meaningful, not the absolutes."""
+        busy = 0.0
+        links: set[int] = set()
+        for phase in phases:
+            for tr in phase:
+                src, dst, nbytes = int(tr[0]), int(tr[1]), float(tr[2])
+                if src == dst or nbytes <= 0:
+                    continue
+                hint = int(tr[3]) if len(tr) > 3 else -1
+                route = self.net._route(src, dst, hint)
+                busy += (nbytes / self.net.flit_bytes) * len(route)
+                links.update(route)
+        obs = self.obs
+        obs.counter_add("ici.detailed.priced_collectives", 1)
+        obs.counter_add(f"ici.detailed.priced_{info.kind}_count", 1)
+        obs.counter_add("ici.detailed.link_busy_cycles", busy)
+        obs.counter_add(
+            "ici.detailed.link_cycle_capacity", len(links) * cycles
+        )
 
-def make_collective_model(topo: Topology, cfg: "IciConfig"):
+
+def make_collective_model(topo: Topology, cfg: "IciConfig", obs=None):
     """The ``icnt_wrapper_init`` equivalent: pick the network
     implementation by config (``-network_mode``)."""
     mode = getattr(cfg, "network_mode", "analytic")
     if mode == "detailed":
-        return DetailedCollectiveModel(topo, cfg)
+        return DetailedCollectiveModel(topo, cfg, obs=obs)
     if mode != "analytic":
         raise ValueError(
             f"unknown network_mode {mode!r} (analytic|detailed)"

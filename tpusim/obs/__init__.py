@@ -1,0 +1,62 @@
+"""tpusim.obs — the unified instrumentation layer.
+
+The reference ships a whole observability pillar: ~300 greppable
+``name = value`` stats per kernel (``gpu-sim.h:550-579``), AerialVision's
+gzip'd interval logs sampled every N cycles
+(``src/gpgpu-sim/visualizer.cc``), and the YAML-regex scraper keyed on
+the exit sentinel.  tpusim's rebuild is this package:
+
+* :mod:`tpusim.obs.hub` — named wall-clock **spans** (pipeline
+  self-profiling: parse → cost → engine → ICI → power) and **counters**,
+  with a no-op default so the hot path is unaffected when disabled;
+* :mod:`tpusim.obs.sampler` — the **cycle-window sampler** (the
+  AerialVision analogue), fed per-op by the timing engine and the
+  detailed ICI network, producing time series of unit utilization,
+  HBM traffic, ICI occupancy, and (via the power coefficients) watts;
+* :mod:`tpusim.obs.export` — Perfetto **counter tracks** merged into the
+  Chrome trace, a JSONL samples file, and Prometheus-style text for the
+  harness.
+
+End-of-run aggregates stay in :mod:`tpusim.sim.stats`; the per-op Chrome
+trace stays in :mod:`tpusim.sim.traceviz`; this package adds the
+time-resolved and self-profiling views on top of both.
+"""
+
+from tpusim.obs.hub import (
+    Instrumentation,
+    NullInstrumentation,
+    NULL_OBS,
+    SpanStat,
+)
+from tpusim.obs.sampler import CycleWindowSampler, WindowBin
+from tpusim.obs.export import (
+    COUNTER_TRACKS,
+    counter_track_events,
+    pod_chrome_trace,
+    prometheus_text,
+    read_samples_jsonl,
+    validate_obs_dir,
+    validate_sample_rows,
+    window_rows,
+    write_obs_dir,
+    write_samples_jsonl,
+)
+
+__all__ = [
+    "Instrumentation",
+    "NullInstrumentation",
+    "NULL_OBS",
+    "SpanStat",
+    "CycleWindowSampler",
+    "WindowBin",
+    "COUNTER_TRACKS",
+    "counter_track_events",
+    "pod_chrome_trace",
+    "prometheus_text",
+    "read_samples_jsonl",
+    "validate_obs_dir",
+    "validate_sample_rows",
+    "window_rows",
+    "write_obs_dir",
+    "write_samples_jsonl",
+]

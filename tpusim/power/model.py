@@ -40,6 +40,32 @@ class PowerCoefficients:
     static_watts: float = 70.0         # leakage
     idle_clock_watts: float = 35.0     # clock tree / sequencer
 
+    def component_picojoules(
+        self,
+        *,
+        mxu_flops: float = 0.0,
+        flops: float = 0.0,
+        transcendentals: float = 0.0,
+        hbm_bytes: float = 0.0,
+        vmem_bytes: float = 0.0,
+        ici_bytes: float = 0.0,
+    ) -> dict[str, float]:
+        """Per-component dynamic energy (pJ) for one set of activity
+        counts — THE energy accounting, shared by the end-of-run
+        :meth:`PowerModel.report` and the obs layer's per-window watts
+        track so the two can't diverge.  VPU flops are the non-MXU,
+        non-transcendental remainder."""
+        return {
+            "mxu": self.mxu_pj_per_flop * mxu_flops,
+            "vpu": self.vpu_pj_per_flop * max(
+                flops - mxu_flops - transcendentals, 0.0
+            ),
+            "sfu": self.sfu_pj_per_op * transcendentals,
+            "hbm": self.hbm_pj_per_byte * hbm_bytes,
+            "vmem": self.vmem_pj_per_byte * vmem_bytes,
+            "ici": self.ici_pj_per_byte * ici_bytes,
+        }
+
     def scaled(self, voltage_scale: float) -> "PowerCoefficients":
         """DVFS voltage scaling (the AccelWattch DVFS slot): per-event
         switching energy goes as V², and leakage roughly tracks V² at
@@ -165,16 +191,14 @@ class PowerModel:
         model's error — the form the hw-validation CSV pipeline compares
         against NVML watts."""
         c = self.coeffs
-        pj = {
-            "mxu": c.mxu_pj_per_flop * result.mxu_flops,
-            "vpu": c.vpu_pj_per_flop * max(
-                result.flops - result.mxu_flops - result.transcendentals, 0.0
-            ),
-            "sfu": c.sfu_pj_per_op * result.transcendentals,
-            "hbm": c.hbm_pj_per_byte * result.hbm_bytes,
-            "vmem": c.vmem_pj_per_byte * result.vmem_bytes,
-            "ici": c.ici_pj_per_byte * result.ici_bytes,
-        }
+        pj = c.component_picojoules(
+            mxu_flops=result.mxu_flops,
+            flops=result.flops,
+            transcendentals=result.transcendentals,
+            hbm_bytes=result.hbm_bytes,
+            vmem_bytes=result.vmem_bytes,
+            ici_bytes=result.ici_bytes,
+        )
         seconds = (
             measured_seconds if measured_seconds is not None
             else result.seconds

@@ -25,6 +25,8 @@ from typing import Any
 from tpusim.ici.detailed import make_collective_model
 from tpusim.ici.topology import Topology, torus_for
 from tpusim.ir import CommandKind, PodTrace, TraceCommand
+from tpusim.obs.hub import NULL_OBS
+from tpusim.obs.sampler import CycleWindowSampler
 from tpusim.sim.stats import EXIT_SENTINEL, StatsRegistry
 from tpusim.timing.config import SimConfig
 from tpusim.timing.engine import Engine, EngineResult
@@ -56,6 +58,11 @@ class SimReport:
     wall_seconds: float = 0.0       # host time spent simulating
     stats: StatsRegistry = field(default_factory=StatsRegistry)
     power: object | None = None     # PowerReport when power_enabled
+    #: pod-level cycle-window series (tpusim.obs) when instrumented
+    samples: object | None = None
+    #: the ArchConfig the run used (export paths need clock/power rates)
+    arch_config: object | None = None
+    dvfs_scale: float = 1.0
 
     @property
     def cycles(self) -> float:
@@ -106,10 +113,18 @@ class SimReport:
 class SimDriver:
     """Replays a :class:`PodTrace` under a :class:`SimConfig`."""
 
-    def __init__(self, config: SimConfig, topology: Topology | None = None):
+    def __init__(
+        self,
+        config: SimConfig,
+        topology: Topology | None = None,
+        obs=None,
+    ):
         self.config = config
         self.arch = config.arch
         self.topology = topology
+        # instrumentation hub (tpusim.obs); the no-op default adds no
+        # stats keys and no per-command work
+        self.obs = obs if obs is not None else NULL_OBS
 
     # ------------------------------------------------------------------
 
@@ -123,11 +138,19 @@ class SimDriver:
             max((m.num_devices for m in pod.modules.values()), default=1),
             len(pod.devices) or 1,
         )
+        obs = self.obs
         topo = self.topology or torus_for(n_devices, arch.name)
-        coll = make_collective_model(topo, arch.ici)
-        engine = Engine(cfg, topology=topo)
+        coll = make_collective_model(topo, arch.ici, obs=obs)
+        engine = Engine(cfg, topology=topo, obs=obs)
 
-        report = SimReport(config_name=arch.name, num_devices=n_devices)
+        report = SimReport(
+            config_name=arch.name, num_devices=n_devices,
+            arch_config=arch, dvfs_scale=cfg.dvfs_scale,
+        )
+        # standalone command events for the pod-level sampler (collective
+        # and memcpy commands don't live in any module series)
+        obs_sampling = obs.enabled and obs.sample
+        cmd_events: list[tuple[str, float, float, float]] = []
 
         # Kernel timing is per-module (SPMD: all devices run the same
         # program) — cache engine results like the reference caches parsed
@@ -141,7 +164,8 @@ class SimDriver:
                         f"command references unknown module {name!r}; "
                         f"trace has {sorted(pod.modules)}"
                     )
-                module_results[name] = engine.run(pod.modules[name])
+                with obs.span("engine"):
+                    module_results[name] = engine.run(pod.modules[name])
             return module_results[name]
 
         # Cross-device collective rendezvous: the k-th standalone collective
@@ -238,9 +262,16 @@ class SimDriver:
                     dma_free[dev_id] = end
                     stream_free[key] = end
                     report.memcpy_cycles += dur
+                    if obs_sampling and dur > 0:
+                        cmd_events.append(
+                            ("dma", start, end, float(cmd.nbytes))
+                        )
 
                 elif cmd.kind == CommandKind.COLLECTIVE and cmd.collective:
-                    secs = coll.seconds(cmd.collective, float(cmd.nbytes))
+                    with obs.span("ici"):
+                        secs = coll.seconds(
+                            cmd.collective, float(cmd.nbytes)
+                        )
                     dur = arch.seconds_to_cycles(secs)
                     start = max(ready, ici_free[dev_id])
                     # rendezvous with the group's k-th collective: all
@@ -259,6 +290,10 @@ class SimDriver:
                     report.totals.collective_count += 1
                     report.totals.ici_bytes += cmd.nbytes
                     report.totals.collective_cycles += dur
+                    if obs_sampling and dur > 0:
+                        cmd_events.append(
+                            ("ici", start, end, float(cmd.nbytes))
+                        )
 
                 else:
                     # comm_init/destroy/group markers: logged no-ops, like
@@ -334,16 +369,45 @@ class SimDriver:
                 ),
             )
 
+        if obs_sampling:
+            # pod assembly: each kernel's module series at its launch
+            # offset (devices sum; exports normalize per device), plus
+            # the standalone command events no module series covers
+            with obs.span("sample"):
+                pod_samples = CycleWindowSampler(obs.window_cycles)
+                for k in report.kernels:
+                    s = k.result.samples
+                    if s is not None:
+                        pod_samples.add_series(
+                            s, offset=k.start_cycle,
+                            length=k.end_cycle - k.start_cycle,
+                        )
+                for unit, s0, s1, nbytes in cmd_events:
+                    if unit == "ici":
+                        pod_samples.add(unit, s0, s1, ici_bytes=nbytes)
+                    else:
+                        pod_samples.add(unit, s0, s1, hbm_bytes=nbytes)
+                report.samples = pod_samples
+                obs.counter_set("samples.windows", pod_samples.num_windows)
+                obs.counter_set(
+                    "samples.window_cycles", pod_samples.window_cycles
+                )
+
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)
         if cfg.power_enabled:
             from tpusim.power.model import PowerModel
 
-            preport = PowerModel(
-                arch.name, dvfs_scale=cfg.dvfs_scale
-            ).report(report.totals)
+            with obs.span("power"):
+                preport = PowerModel(
+                    arch.name, dvfs_scale=cfg.dvfs_scale
+                ).report(report.totals)
             report.stats.update(preport.stats_dict(), prefix="")
             report.power = preport
+        if obs.enabled:
+            # the obs keys ride the same greppable/JSON report; the
+            # disabled path adds none (pinned by tests/test_obs.py)
+            report.stats.update(obs.stats_dict(), prefix="obs_")
         return report
 
 
@@ -353,17 +417,22 @@ def simulate_trace(
     arch: str | None = None,
     overlays: list[Any] | None = None,
     tuned: bool = True,
+    obs=None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
     The ``accel-sim.out -trace ... -config ...`` equivalent
     (``main.cc:55-206``).  ``tuned=False`` skips the committed tuner
     overlay — golden regression sims pin it off so their stats don't
-    shift when a live run refreshes the fit."""
+    shift when a live run refreshes the fit.  ``obs`` is an
+    :class:`tpusim.obs.hub.Instrumentation` for spans + cycle-window
+    sampling (None = the no-op hub)."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
-    pod = load_trace(trace_path)
+    obs = obs if obs is not None else NULL_OBS
+    with obs.span("parse"):
+        pod = load_trace(trace_path)
     if arch is None and config is None:
         # default the arch to the one the trace was captured on, via the
         # named-preset route so the committed tuner overlay applies
@@ -372,5 +441,7 @@ def simulate_trace(
             from tpusim.timing.arch import detect_arch
 
             arch = detect_arch(kind).name
-    cfg = load_config(config, arch=arch, overlays=overlays, tuned=tuned)
-    return SimDriver(cfg).run(pod)
+    with obs.span("config"):
+        cfg = load_config(config, arch=arch, overlays=overlays, tuned=tuned)
+    with obs.span("simulate"):
+        return SimDriver(cfg, obs=obs).run(pod)

@@ -25,9 +25,15 @@ _UNIT_ROWS = {
 
 
 def timeline_to_chrome_trace(
-    result: EngineResult, arch: ArchConfig, process_name: str = "tpusim"
+    result: EngineResult, arch: ArchConfig, process_name: str = "tpusim",
+    extra_events: list[dict] | None = None,
 ) -> dict:
-    """Convert a recorded timeline to the Chrome trace-event format."""
+    """Convert a recorded timeline to the Chrome trace-event format.
+
+    ``extra_events`` lets callers merge additional trace events into the
+    same process — the observability layer appends its Perfetto counter
+    tracks (``tpusim.obs.export.counter_track_events``) here so sampled
+    utilization/bandwidth/power series render above the op rows."""
     events = [
         {"name": "process_name", "ph": "M", "pid": 0,
          "args": {"name": process_name}},
@@ -49,12 +55,20 @@ def timeline_to_chrome_trace(
             "dur": max(dur, 0.001),
             "args": {"op": ev.name, "opcode": ev.opcode, "unit": ev.unit},
         })
+    if extra_events:
+        events.extend(extra_events)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def write_chrome_trace(
     result: EngineResult, arch: ArchConfig, path: str | Path,
     process_name: str = "tpusim",
+    extra_events: list[dict] | None = None,
 ) -> None:
     with open(path, "w") as f:
-        json.dump(timeline_to_chrome_trace(result, arch, process_name), f)
+        json.dump(
+            timeline_to_chrome_trace(
+                result, arch, process_name, extra_events=extra_events
+            ),
+            f,
+        )

@@ -502,6 +502,14 @@ def _fusion_dus_views(
                         )
                     break
                 if dop.base in _CHASE_THROUGH and dop.operands:
+                    # every intermediate view on the chase chain must be
+                    # consumed only by the chain itself: a bitcast that
+                    # also feeds a sibling (e.g. ``reduce(bitcast(p0))``)
+                    # means the kernel reads the FULL buffer through that
+                    # sibling, and capping the parameter at the update
+                    # region would hide the traffic
+                    if not consumers.get(dop.name, set()) <= chain:
+                        break
                     chain.add(dop.name)
                     dest = dop.operands[0]
                     hops += 1
@@ -519,9 +527,19 @@ def _fusion_dus_views(
     return None, param_caps
 
 
-#: a "small" standalone kernel: moved region under two (8,128) f32 tiles,
-#: or a (near-)scalar result — the classes observed paying a fixed
-#: launch floor on v5e silicon regardless of payload
+#: a "small" standalone kernel: moved region up to 32KB — eight (8,128)
+#: f32 tiles — or a (near-)scalar result.  (The 2x factor at the use
+#: site mirrors the read+write doubling ``_region_bytes`` applies, so
+#: the cutoff is on the ONE-SIDED region.)  The fixture evidence
+#: brackets the band rather than sampling inside it: [1,1] slices ran
+#: 229-567ns and the lstm 8KB loop copies 1.57us on v5e — all
+#: launch/latency-dominated — and even a 32KB-region move at stream
+#: rate (~64KB of traffic / ~1100 B/cy ~= 60 cycles) sits far below the
+#: ~700-cycle dispatch floor, so the floor is the binding price through
+#: the whole band; the ``max`` in the floor application keeps genuinely
+#: streaming-bound kernels roofline-priced.  No committed fixture row
+#: falls between 8KB and 32KB to discriminate further — revisit when
+#: one lands.
 _SMALL_KERNEL_REGION_BYTES = 32 * 1024
 _SMALL_KERNEL_RESULT_BYTES = 1024
 
@@ -914,9 +932,18 @@ class CostModel:
                 rows = 1
                 for d in idx.shape:
                     rows *= max(int(d), 1)
-                if idx.rank >= 2:
-                    # trailing index-vector dim enumerates coordinates
-                    rows //= max(int(idx.shape[-1]), 1)
+                # the index-vector dim enumerates COORDINATES of one row,
+                # not rows: divide it out.  HLO records it explicitly
+                # (``index_vector_dim=K``); K == rank means every element
+                # is a scalar row index and nothing is divided out.  Only
+                # when the attr is absent fall back to assuming the
+                # trailing dim is the coordinate vector.
+                try:
+                    ivd = int(op.attrs.get("index_vector_dim", ""))
+                except ValueError:
+                    ivd = -1 if idx.rank >= 2 else None
+                if ivd is not None and -idx.rank <= ivd < idx.rank:
+                    rows //= max(int(idx.shape[ivd]), 1)
                 c.compute_cycles = (
                     max(rows, 1)
                     * float(self.arch.gather_row_overhead_cycles)

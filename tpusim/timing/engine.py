@@ -23,6 +23,8 @@ from dataclasses import dataclass, field
 from tpusim.ici.collectives import CollectiveModel
 from tpusim.ici.detailed import make_collective_model
 from tpusim.ici.topology import Topology, torus_for
+from tpusim.obs.hub import NULL_OBS
+from tpusim.obs.sampler import CycleWindowSampler
 from tpusim.ir import (
     Computation,
     FREE_OPCODES,
@@ -35,6 +37,15 @@ from tpusim.timing.config import SimConfig
 from tpusim.timing.cost import CostModel, OpCost, while_trip_count
 
 __all__ = ["Engine", "EngineResult", "TimelineEvent"]
+
+
+def _sub_sampler_like(parent: CycleWindowSampler) -> CycleWindowSampler:
+    """A fresh sampler for a control-flow body, inheriting the parent's
+    PINNED window (``--obs-window-cycles`` must shape intra-loop
+    structure too); auto parents get auto children."""
+    return CycleWindowSampler(
+        parent.window_cycles if parent.pinned else 0.0
+    )
 
 
 @dataclass
@@ -106,6 +117,10 @@ class EngineResult:
         default_factory=lambda: defaultdict(float)
     )
     timeline: list[TimelineEvent] = field(default_factory=list)
+    #: cycle-window activity series (tpusim.obs.sampler) when the run was
+    #: instrumented; None otherwise.  Not merged/scaled — each module run
+    #: owns its own series, the driver composes them at launch offsets.
+    samples: object | None = None
 
     # -- derived -----------------------------------------------------------
 
@@ -381,6 +396,7 @@ class Engine:
         cost_model: CostModel | None = None,
         record_timeline: bool = False,
         max_timeline_events: int = 100_000,
+        obs=None,
     ):
         self.config = config
         self.arch = config.arch
@@ -388,6 +404,9 @@ class Engine:
         self.topology = topology
         self.record_timeline = record_timeline
         self.max_timeline_events = max_timeline_events
+        # instrumentation hub (tpusim.obs); the no-op default keeps the
+        # hot path to one cached boolean check per op
+        self.obs = obs if obs is not None else NULL_OBS
 
     @staticmethod
     def _peak_live_of(module: ModuleTrace) -> float:
@@ -411,8 +430,11 @@ class Engine:
     def run(self, module: ModuleTrace) -> EngineResult:
         """Simulate one execution of the module's entry computation."""
         topo = self._topology_for(module)
-        coll = make_collective_model(topo, self.arch.ici)
+        coll = make_collective_model(topo, self.arch.ici, obs=self.obs)
         result = EngineResult()
+        sampler = None
+        if self.obs.enabled and self.obs.sample:
+            sampler = CycleWindowSampler(self.obs.window_cycles)
         spill_frac = 1.0
         if self.config.model_vmem_capacity:
             resident = _residency_of(module)
@@ -433,10 +455,11 @@ class Engine:
                 spill_frac = cap / resident
         end = self._run_computation(
             module, module.entry, t0=0.0, coll=coll, result=result, depth=0,
-            spill_frac=spill_frac,
+            spill_frac=spill_frac, sampler=sampler,
         )
         result.cycles = end
         result.seconds = self.arch.cycles_to_seconds(end)
+        result.samples = sampler
         return result
 
     # ------------------------------------------------------------------
@@ -450,11 +473,23 @@ class Engine:
         result: EngineResult,
         depth: int,
         spill_frac: float = 1.0,
+        sampler=None,
     ) -> float:
         """Walk one computation's schedule; returns the finish cycle."""
         if depth > 32:
             return t0
         a = self.arch
+        # self-profiling accumulators (tpusim.obs): wall seconds spent in
+        # the cost model and ICI pricing inside this walk, reported once
+        # at the end — per-op span objects would cost more than the ops
+        obs = self.obs
+        obs_on = obs.enabled
+        cost_wall = 0.0
+        cost_calls = 0
+        ici_wall = 0.0
+        ici_calls = 0
+        if obs_on:
+            from time import perf_counter as _pc
         t = t0
         ici_free = t0
         dma_free = t0
@@ -501,12 +536,30 @@ class Engine:
                         trips = self.config.default_loop_trip_count
                         result.unknown_trip_loops += 1
                 sub = EngineResult()
+                sub_sampler = (
+                    _sub_sampler_like(sampler) if sampler is not None
+                    else None
+                )
                 body_end = self._run_computation(
                     module, module.computation(body_name), 0.0, coll, sub,
-                    depth + 1, spill_frac,
+                    depth + 1, spill_frac, sampler=sub_sampler,
                 )
                 result.merge_scaled(sub, float(trips))
                 dur = body_end * trips + a.op_overhead_cycles * (trips + 1)
+                if sub_sampler is not None and body_end > 0:
+                    # the timeline records one opaque while event; the
+                    # sampler sees through it — one body copy per trip,
+                    # clamped to the body's true duration and spaced by
+                    # the same per-trip overhead the duration carries
+                    # (otherwise late trips drift earlier than the
+                    # timeline by overhead*(k+1) cycles)
+                    sampler.add_series(
+                        sub_sampler,
+                        offset=t + a.op_overhead_cycles,
+                        repeats=int(trips),
+                        period=body_end + a.op_overhead_cycles,
+                        length=body_end,
+                    )
                 self._emit(result, op, t, t + dur, Unit.SCALAR)
                 t += dur
                 result.op_count += 1
@@ -514,19 +567,30 @@ class Engine:
             if base == "conditional" and op.called:
                 durs = []
                 subs = []
+                sub_samplers = []
                 for branch in op.called:
                     if branch not in module.computations:
                         continue
                     sub = EngineResult()
+                    ss = (
+                        _sub_sampler_like(sampler) if sampler is not None
+                        else None
+                    )
                     d = self._run_computation(
                         module, module.computation(branch), 0.0, coll, sub,
-                        depth + 1, spill_frac,
+                        depth + 1, spill_frac, sampler=ss,
                     )
                     durs.append(d)
                     subs.append(sub)
+                    sub_samplers.append(ss)
                 if durs:
                     worst = max(range(len(durs)), key=lambda i: durs[i])
                     result.merge_scaled(subs[worst], 1.0)
+                    if sub_samplers[worst] is not None:
+                        sampler.add_series(
+                            sub_samplers[worst], offset=t,
+                            length=durs[worst],
+                        )
                     dur = durs[worst] + a.op_overhead_cycles
                     if len(durs) > 1 and max(durs) > 1.5 * min(durs):
                         # the worst-case assumption is materially wrong for
@@ -539,11 +603,17 @@ class Engine:
                 continue
             if base == "call" and op.called:
                 sub = EngineResult()
+                sub_sampler = (
+                    _sub_sampler_like(sampler) if sampler is not None
+                    else None
+                )
                 d = self._run_computation(
                     module, module.computation(op.called[0]), 0.0, coll, sub,
-                    depth + 1, spill_frac,
+                    depth + 1, spill_frac, sampler=sub_sampler,
                 )
                 result.merge_scaled(sub, 1.0)
+                if sub_sampler is not None:
+                    sampler.add_series(sub_sampler, offset=t, length=d)
                 self._emit(result, op, t, t + d, Unit.SCALAR)
                 t += d
                 result.op_count += 1
@@ -570,7 +640,13 @@ class Engine:
                 result.op_count += 1
                 continue
 
-            cost = self.cost.op_cost(op, comp, module)
+            if obs_on:
+                _t = _pc()
+                cost = self.cost.op_cost(op, comp, module)
+                cost_wall += _pc() - _t
+                cost_calls += 1
+            else:
+                cost = self.cost.op_cost(op, comp, module)
 
             # ---- vmem capacity: spill the over-subscribed fraction -----
             if spill_frac < 1.0 and cost.vmem_bytes > 0:
@@ -594,7 +670,13 @@ class Engine:
 
             # ---- collectives -------------------------------------------
             if op.is_collective:
-                seconds = coll.seconds(op.collective, cost.ici_bytes)
+                if obs_on:
+                    _t = _pc()
+                    seconds = coll.seconds(op.collective, cost.ici_bytes)
+                    ici_wall += _pc() - _t
+                    ici_calls += 1
+                else:
+                    seconds = coll.seconds(op.collective, cost.ici_bytes)
                 dur = a.seconds_to_cycles(seconds)
                 result.collective_count += 1
                 result.ici_bytes += cost.ici_bytes
@@ -605,10 +687,16 @@ class Engine:
                     start = max(t, ici_free)
                     pending[op.name] = start + dur
                     ici_free = start + dur
+                    if sampler is not None:
+                        sampler.add("ici", start, start + dur,
+                                    ici_bytes=cost.ici_bytes)
                     self._emit(result, op, start, start + dur, Unit.ICI)
                     t += a.op_overhead_cycles  # issue cost on the core
                 else:
                     start = max(t, ici_free)
+                    if sampler is not None:
+                        sampler.add("ici", start, start + dur,
+                                    ici_bytes=cost.ici_bytes)
                     self._emit(result, op, start, start + dur, Unit.ICI)
                     t = start + dur
                     ici_free = t
@@ -643,6 +731,10 @@ class Engine:
                 result.opcode_cycles[base] += dur
                 result.hbm_bytes += cost.hbm_bytes
                 result.per_op_hbm_bytes[op.name] += cost.hbm_bytes
+                if sampler is not None:
+                    sampler.add("dma", start, start + dur,
+                                hbm_bytes=cost.hbm_bytes,
+                                vmem_bytes=cost.vmem_bytes)
                 # per-op correlation sees the EXPOSURE (queueing +
                 # latency + transfer — the device's async events span
                 # issue to completion); the timeline keeps the channel
@@ -710,6 +802,15 @@ class Engine:
                 dur = new_dur
             if dur > 0:
                 self._emit(result, op, t, t + dur, cost.unit)
+                if sampler is not None:
+                    sampler.add(
+                        cost.unit.value, t, t + dur,
+                        hbm_bytes=cost.hbm_bytes,
+                        vmem_bytes=cost.vmem_bytes,
+                        flops=cost.flops,
+                        mxu_flops=cost.mxu_flops,
+                        transcendentals=cost.transcendentals,
+                    )
             t += dur
             result.op_count += 1
             result.flops += cost.flops
@@ -740,6 +841,11 @@ class Engine:
             result.unjoined_async += len(pending)
         for finish in pending.values():
             t = max(t, finish)
+        if obs_on:
+            if cost_calls:
+                obs.add_time("cost", cost_wall, cost_calls)
+            if ici_calls:
+                obs.add_time("ici", ici_wall, ici_calls)
         return t
 
     # ------------------------------------------------------------------
