@@ -10,9 +10,10 @@ tight-relative for derived floats; any diff means the timing model changed
 — rerun with ``--update`` when the change is intended.
 
 Usage:
-    python ci/check_golden.py              # check stats
-    python ci/check_golden.py --update     # regenerate goldens
-    python ci/check_golden.py --obs-smoke  # obs-export schema smoke
+    python ci/check_golden.py                 # check stats
+    python ci/check_golden.py --update        # regenerate goldens
+    python ci/check_golden.py --obs-smoke     # obs-export schema smoke
+    python ci/check_golden.py --faults-smoke  # degraded-pod schema smoke
 """
 
 from __future__ import annotations
@@ -153,6 +154,88 @@ def obs_smoke(out_dir: Path | None = None) -> dict:
             tmp.cleanup()
 
 
+#: the faults smoke fixture: the multi-device golden trace on a tiny v5p
+#: slice, replayed healthy and with one dead ICI link
+FAULTS_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+FAULTS_SCHEMA = REPO / "ci" / "faults_schema.json"
+
+
+def faults_smoke() -> dict:
+    """Degraded-pod contract smoke (mirrors the PR 1 obs-smoke pattern):
+
+    1. the kinds table in ``ci/faults_schema.json`` must match the
+       loader's (``tpusim.faults.FAULT_KINDS``) and every example
+       schedule must round-trip through it;
+    2. a tiny v5p slice replayed with one dead link must run strictly
+       slower than the healthy baseline and stamp every
+       ``stats_required_when_active`` key;
+    3. the healthy replay must stamp NONE of them (no-op default).
+    Raises on violation."""
+    from tpusim.faults import (
+        FAULT_KINDS, link_down_schedule, load_fault_schedule,
+    )
+    from tpusim.ici.topology import torus_for
+    from tpusim.sim.driver import simulate_trace
+
+    schema = json.loads(FAULTS_SCHEMA.read_text())
+    schema_kinds = set(schema["fault_kinds"])
+    if schema_kinds != set(FAULT_KINDS):
+        raise ValueError(
+            f"faults smoke: schema kinds {sorted(schema_kinds)} != "
+            f"loader kinds {sorted(FAULT_KINDS)}"
+        )
+    for kind, doc in schema.get("example_schedules", {}).items():
+        sched = load_fault_schedule(doc)
+        if not sched.faults or sched.faults[0].kind != kind:
+            raise ValueError(
+                f"faults smoke: example schedule for {kind!r} did not "
+                f"round-trip"
+            )
+
+    healthy = simulate_trace(
+        FIXTURES / FAULTS_SMOKE_FIXTURE, arch="v5p", tuned=False,
+    )
+    leaked = [
+        k for k in healthy.stats.values if k.startswith("faults_")
+    ]
+    if leaked:
+        raise ValueError(
+            f"faults smoke: healthy run leaked fault stats {leaked}"
+        )
+    topo = torus_for(healthy.num_devices, "v5p")
+    a, b = topo.undirected_links()[0]
+    sched = link_down_schedule(topo, a, b)
+    faulted = simulate_trace(
+        FIXTURES / FAULTS_SMOKE_FIXTURE, arch="v5p", tuned=False,
+        faults=sched, topology=topo,
+    )
+    missing = [
+        k for k in schema["stats_required_when_active"]
+        if k not in faulted.stats.values
+    ]
+    if missing:
+        raise ValueError(f"faults smoke: missing stats keys {missing}")
+    h_coll = healthy.stats.get("tot_collective_cycles", 0.0)
+    f_coll = faulted.stats.get("tot_collective_cycles", 0.0)
+    if not f_coll > h_coll:
+        raise ValueError(
+            f"faults smoke: dead link did not inflate collective cycles "
+            f"({h_coll} -> {f_coll})"
+        )
+    if not faulted.cycles > healthy.cycles:
+        raise ValueError(
+            f"faults smoke: dead link did not inflate step time "
+            f"({healthy.cycles} -> {faulted.cycles})"
+        )
+    return {
+        "kinds": sorted(schema_kinds),
+        "dead_link": f"{list(topo.coords(a))}->{list(topo.coords(b))}",
+        "step_inflation": faulted.cycles / healthy.cycles,
+        "collective_inflation": f_coll / h_coll if h_coll else float("inf"),
+        "stats_keys": schema["stats_required_when_active"],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -160,7 +243,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--obs-smoke", action="store_true",
                     help="validate the obs export set against "
                          "ci/obs_schema.json instead of checking stats")
+    ap.add_argument("--faults-smoke", action="store_true",
+                    help="validate the fault-schedule contract against "
+                         "ci/faults_schema.json: one-dead-link replay "
+                         "of a tiny v5p slice + stats-key check")
     args = ap.parse_args(argv)
+
+    if args.faults_smoke:
+        try:
+            summary = faults_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --faults-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --faults-smoke: OK (dead link "
+              f"{summary['dead_link']}, step inflation "
+              f"{summary['step_inflation']:.3f}x, collective inflation "
+              f"{summary['collective_inflation']:.3f}x, "
+              f"{len(summary['stats_keys'])} stats keys)")
+        return 0
 
     if args.obs_smoke:
         try:

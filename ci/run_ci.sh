@@ -13,31 +13,37 @@
 #   4. obs     — simulate a golden fixture with the observability layer
 #                on; validate the emitted samples JSONL / Chrome trace /
 #                prometheus text against ci/obs_schema.json
-#   5. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#   5. faults  — degraded-pod smoke: replay a tiny v5p slice with one
+#                dead ICI link; check the fault-schedule contract and
+#                faults_* stat keys against ci/faults_schema.json
+#   6. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-4
+# Usage:  bash ci/run_ci.sh            # tiers 1-5
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/5] build native ==="
+echo "=== [1/6] build native ==="
 make -C native
 
-echo "=== [2/5] unit tests (fast tier) ==="
+echo "=== [2/6] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [3/5] golden-stat regression sims ==="
+echo "=== [3/6] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [4/5] obs export smoke (schema-checked) ==="
+echo "=== [4/6] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
+echo "=== [5/6] faults smoke (degraded-pod contract) ==="
+python ci/check_golden.py --faults-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [5/5] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [6/6] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [5/5] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [6/6] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -1,0 +1,480 @@
+"""Tests for fault injection & degraded-pod simulation (tpusim/faults/).
+
+Covers the ISSUE-2 acceptance surface: schedule schema validation,
+link-down route-around path lengths, the torus→mesh collective fallback,
+straggler/HBM multipliers plumbed to engine cycles, the
+partitioned-topology error message, the driver's faults_* stats
+discipline, the obs faults_active series, and the single-link sweep
+(library + CLI)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.faults import (
+    FaultScheduleError,
+    TopologyPartitionedError,
+    link_down_schedule,
+    load_fault_schedule,
+    single_link_sweep,
+)
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.detailed import DetailedCollectiveModel, TorusNetwork
+from tpusim.ici.topology import Topology, torus_for
+from tpusim.ir import CollectiveInfo
+from tpusim.timing.config import IciConfig, SimConfig
+from tpusim.timing.engine import Engine
+from tpusim.trace.hlo_text import parse_hlo_module
+
+FIXTURES = Path(__file__).parent / "fixtures"
+MB = 1024 * 1024
+
+ICI = IciConfig(
+    link_bandwidth=100e9, efficiency=1.0, hop_latency=1e-6,
+    launch_latency=0.0,
+)
+
+
+def _dead_link_view(topo, a, b):
+    return link_down_schedule(topo, a, b).bind(topo).view_at(0.0)
+
+
+# -- schedule schema validation ---------------------------------------------
+
+def test_schedule_rejects_unknown_kind():
+    with pytest.raises(FaultScheduleError, match="unknown kind"):
+        load_fault_schedule({"faults": [{"kind": "meteor_strike"}]})
+
+
+def test_schedule_requires_endpoints_and_scales():
+    with pytest.raises(FaultScheduleError, match="requires 'dst'"):
+        load_fault_schedule({"faults": [{"kind": "link_down", "src": 0}]})
+    with pytest.raises(FaultScheduleError, match="requires 'chip'"):
+        load_fault_schedule(
+            {"faults": [{"kind": "chip_straggler", "clock_scale": 0.5}]}
+        )
+    with pytest.raises(FaultScheduleError, match="bandwidth_scale"):
+        load_fault_schedule(
+            {"faults": [{"kind": "link_degraded", "src": 0, "dst": 1}]}
+        )
+
+
+def test_schedule_rejects_bad_scale_and_window():
+    for bad in (0.0, -0.5, 1.5, "half"):
+        with pytest.raises(FaultScheduleError, match=r"\(0, 1\]"):
+            load_fault_schedule({"faults": [{
+                "kind": "chip_straggler", "chip": 0, "clock_scale": bad,
+            }]})
+    with pytest.raises(FaultScheduleError, match="empty window"):
+        load_fault_schedule({"faults": [{
+            "kind": "link_down", "src": 0, "dst": 1,
+            "start_cycle": 100, "end_cycle": 100,
+        }]})
+
+
+def test_schedule_rejects_unknown_fields_and_bad_doc():
+    with pytest.raises(FaultScheduleError, match="unknown field"):
+        load_fault_schedule({"faults": [{
+            "kind": "link_down", "src": 0, "dst": 1, "oops": True,
+        }]})
+    with pytest.raises(FaultScheduleError, match="'faults' list"):
+        load_fault_schedule({"nope": []})
+    with pytest.raises(FaultScheduleError, match="invalid"):
+        load_fault_schedule("{not json")
+
+
+def test_bind_validates_coords_and_adjacency():
+    topo = torus_for(64, "v5p")  # 4x4x4
+    # out-of-range coordinate
+    s = load_fault_schedule({"faults": [{
+        "kind": "link_down", "src": [9, 0, 0], "dst": [0, 0, 0],
+    }]})
+    with pytest.raises(FaultScheduleError, match="out of range"):
+        s.bind(topo)
+    # wrong dimensionality
+    s = load_fault_schedule({"faults": [{
+        "kind": "link_down", "src": [0, 0], "dst": [1, 0],
+    }]})
+    with pytest.raises(FaultScheduleError, match="2 dims"):
+        s.bind(topo)
+    # endpoints that are not torus neighbors carry no link
+    s = load_fault_schedule({"faults": [{
+        "kind": "link_down", "src": [0, 0, 0], "dst": [2, 0, 0],
+    }]})
+    with pytest.raises(FaultScheduleError, match="not torus neighbors"):
+        s.bind(topo)
+    # chip id past the pod
+    s = load_fault_schedule({"faults": [{
+        "kind": "hbm_throttle", "chip": 64, "hbm_scale": 0.5,
+    }]})
+    with pytest.raises(FaultScheduleError, match="out of range"):
+        s.bind(topo)
+
+
+def test_schedule_roundtrip_and_windows():
+    doc = {"faults": [
+        {"kind": "link_down", "src": [0, 0, 0], "dst": [0, 1, 0]},
+        {"kind": "chip_straggler", "chip": 3, "clock_scale": 0.5,
+         "start_cycle": 1000.0, "end_cycle": 2000.0},
+    ]}
+    sched = load_fault_schedule(doc)
+    assert sched.windowed
+    assert load_fault_schedule(sched.to_doc()).to_doc() == sched.to_doc()
+    topo = torus_for(64, "v5p")
+    state = sched.bind(topo)
+    assert state.view_at(0.0).num_active == 1      # straggler not yet
+    assert state.view_at(1500.0).num_active == 2
+    assert state.view_at(2500.0).num_active == 1
+    # views are cached per active set
+    assert state.view_at(0.0) is state.view_at(2500.0)
+
+
+# -- link-down routing (detailed network) -----------------------------------
+
+def test_route_around_dead_link_is_longer_and_live():
+    topo = torus_for(64, "v5p")
+    a = topo.chip_at((2, 3, 0))
+    b = topo.chip_at((3, 3, 0))
+    ft = topo.with_faults(_dead_link_view(topo, a, b))
+    healthy = TorusNetwork(topo, flit_bytes=90.0, hop_cycles=1,
+                           use_native=False)
+    faulted = TorusNetwork(ft, flit_bytes=90.0, hop_cycles=1)
+    assert len(healthy._route(a, b)) == 1
+    detour = faulted._route(a, b)
+    # shortest live detour on a wrapped length-4 axis: the long way round
+    assert len(detour) == 3
+    for lid in detour:
+        src, dst = faulted._link_endpoints(lid)
+        assert ft.link_alive(src, dst)
+    # unrelated routes are untouched
+    c, d = topo.chip_at((0, 0, 1)), topo.chip_at((0, 0, 2))
+    assert faulted._route(c, d) == healthy._route(c, d)
+
+
+def test_partitioned_topology_raises_clear_error():
+    line = Topology(dims=(4,), wrap=(False,))
+    mid = _dead_link_view(line, 1, 2)
+    net = TorusNetwork(line.with_faults(mid), flit_bytes=90.0, hop_cycles=1)
+    with pytest.raises(
+        TopologyPartitionedError,
+        match=r"no live ICI route from chip 1 \[1\] to chip 2",
+    ):
+        net._route(1, 2)
+
+
+def test_degraded_link_slows_packet_sim():
+    topo = Topology(dims=(4,), wrap=(True,))
+    sched = load_fault_schedule({"faults": [{
+        "kind": "link_degraded", "src": 0, "dst": 1,
+        "bandwidth_scale": 0.25,
+    }]})
+    view = sched.bind(topo).view_at(0.0)
+    healthy = TorusNetwork(topo, flit_bytes=90.0, hop_cycles=1,
+                           use_native=False)
+    faulted = TorusNetwork(topo.with_faults(view), flit_bytes=90.0,
+                           hop_cycles=1)
+    phases = [[(0, 1, 9000.0)]]
+    th = healthy.run_phases(phases)
+    tf = faulted.run_phases(phases)
+    assert tf > th
+    # serialization term quadruples; hop latency is unchanged
+    assert tf == pytest.approx(th + 3 * (9000.0 / 90.0), rel=1e-6)
+
+
+def test_native_backend_refused_on_faulted_topology():
+    topo = Topology(dims=(4,), wrap=(True,))
+    ft = topo.with_faults(_dead_link_view(topo, 0, 1))
+    with pytest.raises(RuntimeError, match="fault injection"):
+        TorusNetwork(ft, flit_bytes=90.0, hop_cycles=1, use_native=True)
+
+
+# -- torus -> mesh collective fallback (analytic) ---------------------------
+
+def test_dead_wrap_link_falls_back_to_mesh_bandwidth():
+    topo = Topology(dims=(8,), wrap=(True,))
+    model_h = CollectiveModel(topo, ICI)
+    ft = topo.with_faults(_dead_link_view(topo, 0, 7))  # the wrap link
+    model_f = CollectiveModel(ft, ICI)
+    payload = 256 * MB
+    th = model_h.allreduce_seconds(payload, 8)
+    tf = model_f.allreduce_seconds(payload, 8)
+    assert tf > th
+    # 2 directions -> 1: the bandwidth term exactly doubles
+    lat_ring = 2 * 7 * ICI.hop_latency
+    lat_tree = 2 * 3 * ICI.hop_latency
+    bw_h = min(th - lat_ring, th - lat_tree)
+    bw_f = min(tf - lat_ring, tf - lat_tree)
+    assert bw_f == pytest.approx(2 * bw_h, rel=1e-6)
+    assert not ft.axis_ring_intact(0)
+    assert topo.axis_ring_intact(0)
+
+
+def test_degraded_axis_scales_analytic_bandwidth():
+    topo = Topology(dims=(8,), wrap=(True,))
+    sched = load_fault_schedule({"faults": [{
+        "kind": "link_degraded", "src": 2, "dst": 3,
+        "bandwidth_scale": 0.5,
+    }]})
+    ft = topo.with_faults(sched.bind(topo).view_at(0.0))
+    payload = 256 * MB
+    th = CollectiveModel(topo, ICI).allreduce_seconds(payload, 8)
+    tf = CollectiveModel(ft, ICI).allreduce_seconds(payload, 8)
+    assert tf > th
+    # the ring drains at the slowest link: ring axis still intact
+    assert ft.axis_ring_intact(0)
+
+
+def test_detailed_model_inflates_on_dead_link():
+    topo = torus_for(64, "v5p")
+    a, b = topo.chip_at((2, 3, 0)), topo.chip_at((3, 3, 0))
+    ft = topo.with_faults(_dead_link_view(topo, a, b))
+    info = CollectiveInfo("all-reduce", replica_groups=(tuple(range(64)),))
+    th = DetailedCollectiveModel(topo, ICI).seconds(info, 64 * MB)
+    tf = DetailedCollectiveModel(ft, ICI).seconds(info, 64 * MB)
+    assert tf > th
+
+
+# -- straggler / HBM multipliers plumbed to the engine ----------------------
+
+#: two chained dots, no collectives — every cycle is on the chip clock
+#: or the HBM channel, so the multipliers are directly observable
+_DOTS_HLO = """\
+HloModule straggler_test, is_scheduled=true
+
+ENTRY %main (x: bf16[256,256], w: bf16[256,256]) -> bf16[256,256] {
+  %x = bf16[256,256]{1,0:T(8,128)(2,1)} parameter(0)
+  %w = bf16[256,256]{1,0:T(8,128)(2,1)} parameter(1)
+  %dot.1 = bf16[256,256]{1,0:T(8,128)(2,1)} dot(%x, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %dot.2 = bf16[256,256]{1,0:T(8,128)(2,1)} dot(%dot.1, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def dots_module():
+    return parse_hlo_module(_DOTS_HLO)
+
+
+def test_straggler_clock_scale_inflates_engine_cycles(dots_module):
+    cfg = SimConfig()
+    base = Engine(cfg).run(dots_module)
+    clock_only = Engine(cfg, clock_scale=0.5).run(dots_module)
+    hbm_only = Engine(cfg, hbm_scale=0.5).run(dots_module)
+    both = Engine(cfg, clock_scale=0.5, hbm_scale=0.5).run(dots_module)
+    assert clock_only.cycles > base.cycles
+    assert hbm_only.cycles > base.cycles
+    # with chip AND HBM at half rate, every term doubles exactly
+    assert both.cycles == pytest.approx(2.0 * base.cycles, rel=1e-9)
+    # a single-sided derate cannot exceed the fully-derated bound
+    assert clock_only.cycles <= both.cycles
+    assert hbm_only.cycles <= both.cycles
+
+
+def test_hbm_throttle_inflates_memory_bound_cycles(dots_module):
+    cfg = SimConfig()
+    base = Engine(cfg).run(dots_module)
+    slow = Engine(cfg, hbm_scale=0.25).run(dots_module)
+    assert slow.cycles > base.cycles
+
+
+def test_engine_rejects_out_of_range_scales():
+    with pytest.raises(ValueError, match="clock_scale"):
+        Engine(SimConfig(), clock_scale=0.0)
+    with pytest.raises(ValueError, match="clock_scale"):
+        Engine(SimConfig(), hbm_scale=1.5)
+
+
+# -- driver integration ------------------------------------------------------
+
+TRACE = FIXTURES / "traces" / "llama_tiny_tp2dp2"
+
+
+def _replay(**kw):
+    from tpusim.sim.driver import simulate_trace
+
+    return simulate_trace(TRACE, arch="v5p", tuned=False, **kw)
+
+
+def test_driver_stamps_fault_stats_only_when_enabled():
+    healthy = _replay()
+    assert not any(
+        k.startswith("faults_") for k in healthy.stats.values
+    )
+    topo = torus_for(healthy.num_devices, "v5p")
+    a, b = topo.undirected_links()[0]
+    faulted = _replay(faults=link_down_schedule(topo, a, b), topology=topo)
+    s = faulted.stats
+    assert s.get("faults_active") == 1
+    assert s.get("faults_links_down") == 2       # directed count
+    assert s.get("faults_min_link_scale") == 0.0
+    assert faulted.cycles > healthy.cycles
+
+
+def test_driver_straggler_slows_only_that_chips_kernels():
+    from tpusim.ir import CommandKind, PodTrace, TraceCommand
+    from tpusim.sim.driver import SimDriver
+
+    # two devices launching the same (collective-free) module: only the
+    # straggler's kernel re-times under its multiplier class
+    def pod():
+        p = PodTrace(meta={"num_devices": 2})
+        p.modules["m"] = parse_hlo_module(_DOTS_HLO)
+        for d in (0, 1):
+            p.device(d).commands.append(TraceCommand(
+                kind=CommandKind.KERNEL_LAUNCH, device_id=d, module="m",
+            ))
+        return p
+
+    cfg = SimConfig()
+    healthy = SimDriver(cfg).run(pod())
+    st = SimDriver(cfg, faults={"faults": [{
+        "kind": "chip_straggler", "chip": 0, "clock_scale": 0.5,
+    }]}).run(pod())
+    assert st.stats.get("faults_chips_degraded") == 1
+    assert st.cycles > healthy.cycles
+    k_h = {k.device_id: k.end_cycle - k.start_cycle
+           for k in healthy.kernels}
+    k_s = {k.device_id: k.end_cycle - k.start_cycle for k in st.kernels}
+    assert k_s[0] > k_h[0]
+    assert k_s[1] == pytest.approx(k_h[1])
+
+
+def test_driver_accepts_schedule_path(tmp_path):
+    topo = torus_for(4, "v5p")
+    a, b = topo.undirected_links()[0]
+    p = tmp_path / "sched.json"
+    p.write_text(json.dumps(link_down_schedule(topo, a, b).to_doc()))
+    rep = _replay(faults=str(p))
+    assert rep.stats.get("faults_links_down") == 2
+
+
+def test_obs_surfaces_faults_active_series():
+    from tpusim.obs import Instrumentation, window_rows
+
+    obs = Instrumentation()
+    topo = torus_for(4, "v5p")
+    a, b = topo.undirected_links()[0]
+    rep = _replay(
+        faults=link_down_schedule(topo, a, b), topology=topo, obs=obs,
+    )
+    rows = window_rows(rep.samples, rep.arch_config, 1)
+    assert rows and all("faults_active" in r for r in rows)
+    # one unwindowed fault: active in (essentially) every window
+    assert max(r["faults_active"] for r in rows) == pytest.approx(1.0)
+    # healthy obs runs carry the key at 0.0
+    obs2 = Instrumentation()
+    rep2 = _replay(obs=obs2)
+    rows2 = window_rows(rep2.samples, rep2.arch_config, 1)
+    assert all(r["faults_active"] == 0.0 for r in rows2)
+
+
+def test_windowed_link_fault_applies_only_within_window():
+    """A link fault with a cycle window hits the standalone collectives
+    it overlaps and spares the ones before it."""
+    from tpusim.ir import CommandKind, CollectiveInfo, PodTrace, TraceCommand
+    from tpusim.sim.driver import SimDriver
+
+    n, nb = 8, 64 * MB
+    topo = Topology(dims=(8,), wrap=(True,))
+    info = CollectiveInfo("all-reduce", replica_groups=(tuple(range(n)),))
+
+    def pod():
+        p = PodTrace(meta={"num_devices": n})
+        for d in range(n):
+            for _ in range(2):
+                p.device(d).commands.append(TraceCommand(
+                    kind=CommandKind.COLLECTIVE, device_id=d, nbytes=nb,
+                    collective=info,
+                ))
+        return p
+
+    cfg = SimConfig()
+    healthy = SimDriver(cfg, topology=topo).run(pod())
+    first_end = healthy.cycles / 2.0  # two identical back-to-back colls
+
+    def dead_wrap(window):
+        rec = {"kind": "link_down", "src": 0, "dst": 7}
+        rec.update(window)
+        return {"faults": [rec]}
+
+    full = SimDriver(cfg, topology=topo, faults=dead_wrap({})).run(pod())
+    # window opens just before the second collective issues (at
+    # first_end), so the first prices healthy and the second degraded
+    windowed = SimDriver(
+        cfg, topology=topo,
+        faults=dead_wrap({"start_cycle": first_end * 0.99}),
+    ).run(pod())
+    # only the second collective runs degraded: strictly between the
+    # healthy and fully-faulted pods
+    assert healthy.cycles < windowed.cycles < full.cycles
+    assert windowed.cycles == pytest.approx(
+        (healthy.cycles + full.cycles) / 2.0, rel=1e-6
+    )
+
+
+def test_windowed_straggler_hits_only_overlapped_kernels():
+    """Chip-fault windows resolve at kernel-issue grain: a straggler
+    window opening after the first launch slows only the second."""
+    from tpusim.ir import CommandKind, PodTrace, TraceCommand
+    from tpusim.sim.driver import SimDriver
+
+    def pod():
+        p = PodTrace(meta={"num_devices": 1})
+        p.modules["m"] = parse_hlo_module(_DOTS_HLO)
+        for _ in range(2):
+            p.device(0).commands.append(TraceCommand(
+                kind=CommandKind.KERNEL_LAUNCH, device_id=0, module="m",
+            ))
+        return p
+
+    def straggle(window):
+        rec = {"kind": "chip_straggler", "chip": 0, "clock_scale": 0.5}
+        rec.update(window)
+        return {"faults": [rec]}
+
+    cfg = SimConfig()
+    healthy = SimDriver(cfg).run(pod())
+    first_end = healthy.cycles / 2.0
+    full = SimDriver(cfg, faults=straggle({})).run(pod())
+    windowed = SimDriver(
+        cfg, faults=straggle({"start_cycle": first_end * 0.99}),
+    ).run(pod())
+    late = SimDriver(
+        cfg, faults=straggle({"start_cycle": healthy.cycles * 10}),
+    ).run(pod())
+    assert healthy.cycles < windowed.cycles < full.cycles
+    # a window that never opens during the run changes nothing (but the
+    # schedule-shape stats still describe it)
+    assert late.cycles == pytest.approx(healthy.cycles)
+    assert late.stats.get("faults_chips_degraded") == 1
+
+
+# -- single-link-failure sweep ----------------------------------------------
+
+def test_single_link_sweep_inflates_every_scenario():
+    topo = torus_for(64, "v5p")
+    res = single_link_sweep(topo, ICI, payload_bytes=64 * MB)
+    assert len(res.rows) == len(topo.undirected_links()) == 192
+    assert all(r.inflation > 1.0 for r in res.rows)
+    assert res.worst is not None and res.worst.inflation > 1.0
+    doc = res.to_doc()
+    assert doc["scenarios"] == 192 and doc["worst_inflation"] > 1.0
+
+
+def test_faults_cli_sweep(capsys, tmp_path):
+    from tpusim.__main__ import main
+
+    out = tmp_path / "sweep.json"
+    rc = main([
+        "faults", "--arch", "v5p", "--chips", "64",
+        "--payload-mb", "16", "--top", "3", "--json", str(out),
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "4x4x4 torus (64 chips, 192 scenarios)" in text
+    assert "worst-case inflation" in text
+    assert "192/192 scenarios inflate" in text
+    doc = json.loads(out.read_text())
+    assert doc["sweep_kind"] == "collective"
+    assert len(doc["rows"]) == 192

@@ -27,6 +27,67 @@ def test_procman_runs_jobs(tmp_path):
     assert "job 2" in (tmp_path / "j2.log").read_text()
 
 
+def test_procman_retries_job_killed_by_signal(tmp_path):
+    """A job that dies from a transient signal is no longer terminal:
+    with retries budgeted it is reaped and resubmitted (exponential
+    backoff), and the second attempt succeeds."""
+    marker = tmp_path / "first_attempt_done"
+    code = (
+        "import os, signal\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "print('recovered')\n"
+    )
+    pm = ProcMan(parallel=1)
+    job = pm.submit(
+        [sys.executable, "-c", code],
+        log_path=tmp_path / "flaky.log",
+        retries=1, backoff_s=0.01,
+    )
+    assert pm.run(poll_s=0.02)
+    assert job.status == "done"
+    assert job.attempts == 2
+    assert job.retried == 1
+    s = pm.status_summary()
+    assert s["done"] == 1 and s["retries"] == 1
+    log = (tmp_path / "flaky.log").read_text()
+    assert "retry attempt 2/2" in log and "recovered" in log
+    pm.dump_state(tmp_path / "jobs.json")
+    state = __import__("json").loads((tmp_path / "jobs.json").read_text())
+    assert state[0]["attempts"] == 2
+
+
+def test_procman_retry_budget_exhausts_to_failed(tmp_path):
+    pm = ProcMan(parallel=1)
+    job = pm.submit(
+        [sys.executable, "-c", "raise SystemExit(7)"],
+        log_path=tmp_path / "always_bad.log",
+        retries=2, backoff_s=0.01,
+    )
+    assert not pm.run(poll_s=0.02)
+    assert job.status == "failed"
+    assert job.attempts == 3          # 1 original + 2 resubmissions
+    assert job.returncode == 7
+
+
+def test_procman_backoff_grows_and_caps():
+    from tpusim.harness.procman import MAX_BACKOFF_S, Job
+
+    j = Job(job_id=3, cmd=["x"], retries=10, backoff_s=0.5)
+    delays = []
+    for attempt in (1, 2, 3, 4):
+        j.attempts = attempt
+        delays.append(j.next_backoff_s())
+    # exponential (jitter <= 25%) and bounded
+    assert delays[1] > delays[0] and delays[2] > delays[1]
+    for base, got in zip((0.5, 1.0, 2.0, 4.0), delays):
+        assert base <= got <= min(base * 1.25, MAX_BACKOFF_S)
+    j.attempts = 30
+    assert j.next_backoff_s() == MAX_BACKOFF_S
+
+
 def test_procman_reports_failure(tmp_path):
     pm = ProcMan(parallel=2)
     pm.submit([sys.executable, "-c", "raise SystemExit(3)"],

@@ -167,3 +167,67 @@ def test_module_collective(tiny_mlp):
 def test_module_root(tiny_mlp):
     assert tiny_mlp.entry.root.name == "dot.2"
     assert tiny_mlp.entry.root.result.shape == (128, 64)
+
+
+# -- lenient (salvage) parse mode --------------------------------------------
+
+def _corrupt_mlp_text() -> str:
+    """The tiny_mlp dump with one instruction line corrupted the way a
+    truncated capture write corrupts it (mangled shape text)."""
+    lines = (FIXTURES / "tiny_mlp.hlo").read_text().splitlines()
+    out = []
+    for line in lines:
+        if line.lstrip().startswith("%relu.1 ="):
+            out.append(
+                "  %relu.1 = bf16[128,&&&GARBAGE] fusion(%dot.1), "
+                "kind=kLoop, calls=%fused_relu"
+            )
+        else:
+            out.append(line)
+    return "\n".join(out)
+
+
+def test_strict_parse_raises_on_corrupt_line():
+    with pytest.raises(ValueError, match="malformed HLO line"):
+        parse_hlo_module(_corrupt_mlp_text())
+
+
+def test_lenient_parse_skips_corrupt_line_with_counted_warning():
+    with pytest.warns(UserWarning, match="skipped 1 malformed"):
+        mod = parse_hlo_module(_corrupt_mlp_text(), strict=False)
+    assert mod.meta["parse_skipped_lines"] == 1
+    # everything else survived: one op lost from the entry, rest intact
+    assert len(mod.entry.ops) == 7
+    assert mod.entry.root.name == "dot.2"
+    assert set(mod.computations) == {"region_add", "fused_relu", "main.10"}
+
+
+def test_lenient_parse_clean_text_adds_no_meta():
+    mod = parse_hlo_module(
+        (FIXTURES / "tiny_mlp.hlo").read_text(), strict=False
+    )
+    assert "parse_skipped_lines" not in mod.meta
+    assert len(mod.entry.ops) == 8
+
+
+def test_lenient_load_trace_and_cli_flag(tmp_path):
+    """--lenient-parse end to end: a trace dir with one corrupt module
+    line loads (and replays) in salvage mode, raises in strict mode."""
+    from tpusim.trace.format import load_trace
+
+    trace = tmp_path / "trace"
+    (trace / "modules").mkdir(parents=True)
+    (trace / "modules" / "m.hlo").write_text(_corrupt_mlp_text())
+    (trace / "meta.json").write_text('{"num_devices": 1}')
+    # strict mode raises (native scanner or python reference path)
+    with pytest.raises(ValueError, match="GARBAGE"):
+        load_trace(trace)
+    with pytest.warns(UserWarning, match="skipped 1 malformed"):
+        pod = load_trace(trace, lenient=True)
+    assert pod.modules["m"].meta["parse_skipped_lines"] == 1
+
+    from tpusim.sim.driver import simulate_trace
+
+    with pytest.warns(UserWarning):
+        report = simulate_trace(trace, arch="v5e", lenient=True)
+    assert report.cycles > 0

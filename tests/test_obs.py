@@ -151,8 +151,11 @@ def test_span_nesting_and_timing_monotonicity():
     manual = obs.spans["outer/manual"]
     assert inner.count == 2 and inner.seconds >= 0.004
     assert manual.count == 3 and manual.seconds == pytest.approx(0.001)
-    # a parent's wall covers its children; self time is the difference
-    assert outer.seconds >= inner.seconds + manual.seconds
+    # a parent's wall covers its REAL children (add_time attributes
+    # claimed seconds that need not be backed by the parent's wall, so
+    # it joins child_seconds but not this bound — asserting it did made
+    # the test flake whenever span overhead dipped below the claim)
+    assert outer.seconds >= inner.seconds
     assert outer.child_seconds == pytest.approx(
         inner.seconds + manual.seconds
     )

@@ -43,8 +43,14 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         from tpusim.obs import Instrumentation
 
         obs = Instrumentation(window_cycles=args.obs_window_cycles)
+    faults = None
+    if args.faults:
+        from tpusim.faults import load_fault_schedule
+
+        faults = load_fault_schedule(args.faults)
     report = simulate_trace(
-        args.trace, arch=args.arch, overlays=overlays, obs=obs
+        args.trace, arch=args.arch, overlays=overlays, obs=obs,
+        faults=faults, lenient=args.lenient_parse,
     )
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -314,6 +320,53 @@ def _cmd_profile(args: argparse.Namespace) -> int:
         pct = 100.0 * cyc / device_time if device_time else 0.0
         print(f"  {name[:40]:40s} {opcode[:18]:18s} {cyc:12.4g} "
               f"{count:8.0f} {pct:8.2f}%")
+    return 0
+
+
+def _cmd_faults(args: argparse.Namespace) -> int:
+    """Single-link-failure sweep: price a collective (or replay a trace)
+    once per dead link and report worst-case step-time inflation — the
+    "what if link (2,3,0)->(3,3,0) is down?" answer, for every link."""
+    from tpusim.faults.sweep import single_link_sweep, trace_step_sweep
+    from tpusim.ici.topology import torus_for
+    from tpusim.timing.config import load_config
+
+    cfg = load_config(arch=args.arch)
+    arch_name = cfg.arch.name
+    topo = torus_for(args.chips, arch_name)
+    if args.trace:
+        result = trace_step_sweep(
+            args.trace, topo, arch=args.arch,
+            max_scenarios=args.max_scenarios,
+        )
+        what = f"step time ({result.unit})"
+    else:
+        result = single_link_sweep(
+            topo, cfg.arch.ici,
+            payload_bytes=args.payload_mb * 1024 * 1024,
+            kind=args.kind,
+        )
+        what = f"{args.kind} ({result.unit})"
+    dims = "x".join(str(d) for d in topo.dims)
+    print(f"tpusim faults: single-link-failure sweep on {arch_name} "
+          f"{dims} torus ({topo.num_chips} chips, "
+          f"{len(result.rows)} scenarios)")
+    print(f"  healthy {what}: {result.healthy:.6g}")
+    worst = result.worst
+    if worst is not None:
+        print(f"  worst-case inflation: {worst.inflation:.3f}x at link "
+              f"{worst.label()}")
+    top = sorted(result.rows, key=lambda r: -r.inflation)[: args.top]
+    for r in top:
+        print(f"    {r.label():24s} {r.value:.6g} "
+              f"({r.inflation:.3f}x)")
+    degraded = sum(1 for r in result.rows if r.inflation > 1.0 + 1e-12)
+    print(f"  {degraded}/{len(result.rows)} scenarios inflate the "
+          f"healthy baseline")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result.to_doc(), f, indent=2)
+        print(f"  sweep report written to {args.json}")
     return 0
 
 
@@ -630,6 +683,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="cycle-window size for the sampler "
                          "(0 = auto: self-coarsening to a bounded "
                          "window count)")
+    ps.add_argument("--faults", default=None, metavar="SCHEDULE.json",
+                    help="fault schedule (dead/degraded ICI links, chip "
+                         "stragglers, HBM throttles — see "
+                         "ci/faults_schema.json); stamps faults_* stats")
+    ps.add_argument("--lenient-parse", action="store_true",
+                    help="skip malformed HLO lines with a counted "
+                         "warning instead of raising mid-file (salvage "
+                         "mode for damaged captures)")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")
@@ -734,6 +795,30 @@ def main(argv: list[str] | None = None) -> int:
     pp.add_argument("--top", type=int, default=10,
                     help="how many costliest ops to print")
     pp.set_defaults(fn=_cmd_profile)
+
+    pfa = sub.add_parser(
+        "faults",
+        help="single-link-failure sweep: worst-case step-time inflation "
+             "over every dead-link scenario (degraded-pod what-ifs)",
+    )
+    pfa.add_argument("--arch", default="v5p")
+    pfa.add_argument("--chips", type=int, default=64,
+                     help="pod size to sweep (default 64 = v5p 4x4x4)")
+    pfa.add_argument("--kind", default="all-reduce",
+                     help="collective to price per scenario "
+                          "(analytic sweep)")
+    pfa.add_argument("--payload-mb", type=float, default=64.0,
+                     help="per-chip payload for the analytic sweep")
+    pfa.add_argument("--trace", default=None,
+                     help="replay this trace per scenario instead "
+                          "(end-to-end step-time inflation; slower)")
+    pfa.add_argument("--max-scenarios", type=int, default=16,
+                     help="scenario cap for --trace sweeps")
+    pfa.add_argument("--top", type=int, default=5,
+                     help="how many worst links to print")
+    pfa.add_argument("--json", default=None,
+                     help="write the full sweep report here")
+    pfa.set_defaults(fn=_cmd_faults)
 
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")

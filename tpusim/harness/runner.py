@@ -110,11 +110,18 @@ def run_experiments(
     timeout_s: float | None = 1800,
     monitor_interval_s: float | None = None,
     csv_path: str | Path | None = None,
+    retries: int = 1,
+    backoff_s: float = 0.5,
 ) -> dict[str, dict[str, object]]:
     """Fabricate run dirs, execute all cells, monitor, scrape results.
     Returns run-name → stats (plus '__failed__' listing); also writes
-    ``jobs.json`` (status DB), ``failures.json`` (sentinel audit) and
-    optionally a stats CSV."""
+    ``jobs.json`` (status DB, including per-job attempt counts),
+    ``failures.json`` (sentinel audit) and optionally a stats CSV.
+
+    ``retries``: extra attempts per failed job (exponential backoff with
+    jitter via :class:`~tpusim.harness.procman.ProcMan`); the default of
+    one resubmission absorbs transient box flake without masking a
+    deterministic simulator failure for long."""
     out_root = Path(out_root)
     pm = ProcMan(parallel=parallel)
     for spec in specs:
@@ -131,7 +138,10 @@ def run_experiments(
             # per-run time series + prometheus text land beside the log,
             # scrapeable like the stats JSON
             cmd += ["--obs-out", str(run_dir / "obs")]
-        pm.submit(cmd, log_path=run_dir / "run.log")
+        pm.submit(
+            cmd, log_path=run_dir / "run.log",
+            retries=retries, backoff_s=backoff_s,
+        )
     on_tick = _monitor_printer(monitor_interval_s) if monitor_interval_s \
         else None
     pm.run(timeout_s=timeout_s, on_tick=on_tick)
@@ -139,19 +149,36 @@ def run_experiments(
     rows = scrape_run_dirs(out_root, "**/run.log")
 
     # sentinel audit — a job that exited 0 but never printed the exit
-    # sentinel is still a failure (monitor_func_test.py:66-75)
+    # sentinel is still a failure (monitor_func_test.py:66-75); attempt
+    # counts ride both the audit and the scraped rows so downstream
+    # tooling sees how hard each result was to get
     failures = []
     for j in pm.jobs:
-        ok_log = j.log_path and Path(j.log_path).exists() and (
-            "TPUSIM: *** exit detected ***" in Path(j.log_path).read_text()
+        log = Path(j.log_path) if j.log_path else None
+        ok_log = log is not None and log.exists() and (
+            "TPUSIM: *** exit detected ***" in log.read_text()
         )
         if j.status != "done" or not ok_log:
             failures.append({
                 "job_id": j.job_id, "status": j.status,
                 "returncode": j.returncode, "log": j.log_path,
                 "sentinel": bool(ok_log),
+                "attempts": j.attempts,
             })
+        elif j.retried and log is not None:
+            try:
+                key = str(log.relative_to(out_root))
+            except ValueError:
+                key = log.name
+            if key in rows:
+                rows[key]["job_attempts"] = j.attempts
+    summary = pm.status_summary()
     (out_root / "failures.json").write_text(json.dumps(failures, indent=2))
+    if summary.get("retries"):
+        (out_root / "retries.json").write_text(json.dumps({
+            "retry_total": summary["retries"],
+            "jobs_retried": sum(1 for j in pm.jobs if j.retried),
+        }, indent=2))
     if csv_path:
         write_csv(rows, csv_path)
     return rows
@@ -170,13 +197,18 @@ def run_suite(
     obs: bool = False,
     timeout_s: float | None = 1800,
     monitor_interval_s: float | None = 10.0,
+    retries: int = 1,
+    capture_retries: int = 2,
 ) -> dict[str, dict[str, object]]:
     """The ``tpusim run -B suite -C v5p,v5e`` flow: resolve the suite,
     locate (or capture) each workload's trace, fabricate the suite×config
     matrix, run with monitoring, and emit ``stats.csv``.
 
     ``configs`` items are ``arch`` or ``arch+named`` where ``named`` is a
-    config from the YAML ``configs:`` section."""
+    config from the YAML ``configs:`` section.  Capture jobs run against
+    a live (flaky) backend and default to more resubmissions
+    (``capture_retries``) than the deterministic simulate jobs
+    (``retries``)."""
     from tpusim.harness.suites import load_named_configs, load_suite
 
     out_root = Path(out_root)
@@ -207,12 +239,19 @@ def run_suite(
             ]
             for k, v in e.params.items():
                 cmd += ["--set", f"{k}={v}"]
-            cap_pm.submit(cmd, log_path=trace_root / f"{e.run_name}.capture.log")
+            cap_pm.submit(
+                cmd, log_path=trace_root / f"{e.run_name}.capture.log",
+                retries=capture_retries,
+            )
         on_tick = _monitor_printer(monitor_interval_s) \
             if monitor_interval_s else None
         if not cap_pm.run(timeout_s=timeout_s, on_tick=on_tick):
-            bad = [j.log_path for j in cap_pm.jobs if j.status != "done"]
+            bad = [
+                f"{j.log_path} (attempts={j.attempts})"
+                for j in cap_pm.jobs if j.status != "done"
+            ]
             raise RuntimeError(f"capture phase failed: {bad}")
+        cap_pm.dump_state(trace_root / "capture_jobs.json")
 
     # phase 2: the simulation matrix
     specs: list[RunSpec] = []
@@ -239,4 +278,5 @@ def run_suite(
         specs, out_root, parallel=parallel, timeout_s=timeout_s,
         monitor_interval_s=monitor_interval_s,
         csv_path=out_root / "stats.csv",
+        retries=retries,
     )

@@ -73,10 +73,33 @@ class CollectiveModel:
 
     def _directions(self, n: int) -> int:
         """Usable link directions for a group of n chips: 2 per spanned
-        axis (bidirectional ICI)."""
+        axis (bidirectional ICI).  With a fault view attached, an axis
+        whose ring is broken by a dead link falls back to the mesh term
+        — one rotation direction instead of two counter-rotating rings
+        (the torus→mesh degradation a dead wrap link forces)."""
         if n <= 1:
             return 1
-        return max(2 * len(self._axes_for_group(n)), 1)
+        axes = self._axes_for_group(n)
+        faults = self.topo.faults
+        if faults is not None and faults.broken_axes:
+            return max(
+                sum(1 if ax in faults.broken_axes else 2 for ax in axes), 1
+            )
+        return max(2 * len(axes), 1)
+
+    def _fault_bw_scale(self, n: int) -> float:
+        """Bandwidth multiplier from degraded (not dead) links on the
+        group's spanned axes: a ring schedule drains at its slowest
+        link, so the axis bottlenecks at the worst per-link scale.
+        1.0 on a healthy topology — the fault-free path is unchanged."""
+        faults = self.topo.faults
+        if faults is None or not faults.axis_min_scale:
+            return 1.0
+        return min(
+            (faults.axis_min_scale.get(ax, 1.0)
+             for ax in self._axes_for_group(n)),
+            default=1.0,
+        )
 
     def _spans_dcn(self, n: int) -> bool:
         return 0 < self.cfg.chips_per_slice < n
@@ -95,7 +118,7 @@ class CollectiveModel:
     def allreduce_seconds(self, payload: float, n: int) -> float:
         if n <= 1 or payload <= 0:
             return self.cfg.launch_latency
-        w = self._link_bw() * self._directions(n)
+        w = self._link_bw() * self._directions(n) * self._fault_bw_scale(n)
         ring_bw = 2.0 * (n - 1) / n * payload / w
         ring_lat = 2.0 * (n - 1) * self.cfg.hop_latency
         tree_bw = 2.0 * payload / w
@@ -109,7 +132,7 @@ class CollectiveModel:
         """``full_bytes`` = the gathered (output) size."""
         if n <= 1 or full_bytes <= 0:
             return self.cfg.launch_latency
-        w = self._link_bw() * self._directions(n)
+        w = self._link_bw() * self._directions(n) * self._fault_bw_scale(n)
         t = (n - 1) / n * full_bytes / w + (n - 1) * self.cfg.hop_latency
         if self._spans_dcn(n):
             t = max(t, 0.5 * self._dcn_term(full_bytes, n))
@@ -125,6 +148,7 @@ class CollectiveModel:
             return self.cfg.launch_latency
         axes = self._axes_for_group(n)
         w = self._link_bw()
+        faults = self.topo.faults
         t = 0.0
         remaining = n
         for ax in axes:
@@ -135,7 +159,15 @@ class CollectiveModel:
             # byte-hops = payload * n_ax^2 / 4 (mean shortest-path hop
             # distance n_ax/4) spread over 2*n_ax directed links of
             # bandwidth w -> per-link traffic payload * n_ax / 8
-            t += payload * n_ax / (8.0 * w)
+            w_ax = w
+            denom = 8.0
+            if faults is not None:
+                # a broken ring halves the usable directed links on the
+                # axis; degraded links bottleneck it at their worst scale
+                if ax in faults.broken_axes:
+                    denom = 4.0
+                w_ax *= faults.axis_min_scale.get(ax, 1.0)
+            t += payload * n_ax / (denom * w_ax)
             t += (n_ax / 2.0) * self.cfg.hop_latency
             remaining = max(remaining // n_ax, 1)
         if self._spans_dcn(n):
@@ -150,6 +182,10 @@ class CollectiveModel:
         if not pairs or payload <= 0:
             return self.cfg.launch_latency
         w = self._link_bw()
+        faults = self.topo.faults
+        if faults is not None and faults.scales:
+            # conservative: a shift chain drains at its slowest link
+            w *= min(faults.scales.values())
         max_hops = 1
         out_degree: dict[int, int] = {}
         for s, t_ in pairs:

@@ -115,11 +115,21 @@ class TorusNetwork:
         self.hop_cycles = int(hop_cycles)
         if self.flit_bytes <= 0:
             raise ValueError("flit_bytes must be positive")
-        self._native = (
+        # the native backend knows nothing about dead/degraded links; a
+        # faulted topology always runs the python twin
+        self._faulted = topo.has_faults
+        if self._faulted and use_native:
+            raise RuntimeError(
+                "native ici_net does not support fault injection; "
+                "a faulted topology runs the python backend"
+            )
+        self._native = not self._faulted and (
             native_net_available() if use_native is None else use_native
         )
-        if self._native and not native_net_available():
+        if use_native and not self._faulted and not native_net_available():
             raise RuntimeError("native ici_net requested but not built")
+        self._detour_cache: dict[tuple[int, int], list[int]] = {}
+        self._scale_cache: dict[int, float] = {}
 
     # -- public ------------------------------------------------------------
 
@@ -175,9 +185,80 @@ class TorusNetwork:
 
     # -- python backend (the contract reference) ---------------------------
 
+    def _link_endpoints(self, lid: int) -> tuple[int, int | None]:
+        """Decode a directed link id back to ``(src, dst)`` chips."""
+        nd = self.topo.ndims
+        direction = lid % 2
+        axis = (lid // 2) % nd
+        src = lid // (2 * nd)
+        return src, self.topo.neighbor(src, axis, direction)
+
+    def _lid_scale(self, lid: int) -> float:
+        """Bandwidth multiplier of one directed link (memoized)."""
+        s = self._scale_cache.get(lid)
+        if s is None:
+            a, b = self._link_endpoints(lid)
+            s = self.topo.link_scale(a, b) if b is not None else 1.0
+            self._scale_cache[lid] = s
+        return s
+
+    def _route_around(self, src: int, dst: int) -> list[int]:
+        """BFS shortest path over LIVE links only — the fallback when the
+        dimension-order route crosses a dead link.  Raises
+        :class:`~tpusim.faults.TopologyPartitionedError` when the dead
+        links disconnect ``src`` from ``dst``."""
+        key = (src, dst)
+        cached = self._detour_cache.get(key)
+        if cached is not None:
+            return cached
+        from collections import deque
+
+        topo = self.topo
+        nd = topo.ndims
+        prev: dict[int, tuple[int, int] | None] = {src: None}
+        q = deque([src])
+        while q:
+            cur = q.popleft()
+            if cur == dst:
+                break
+            for axis in range(nd):
+                if topo.dims[axis] <= 1:
+                    continue
+                for direction in (0, 1):
+                    nxt = topo.neighbor(cur, axis, direction)
+                    if nxt is None or nxt in prev:
+                        continue
+                    if not topo.link_alive(cur, nxt):
+                        continue
+                    prev[nxt] = (cur, (cur * nd + axis) * 2 + direction)
+                    q.append(nxt)
+        if dst not in prev:
+            from tpusim.faults import TopologyPartitionedError
+
+            faults = topo.faults
+            ndead = getattr(faults, "links_down", 0)
+            raise TopologyPartitionedError(
+                f"topology partitioned: no live ICI route from chip {src} "
+                f"{list(topo.coords(src))} to chip {dst} "
+                f"{list(topo.coords(dst))} with {ndead} directed link(s) "
+                f"down — the fault schedule disconnects the pod"
+            )
+        links: list[int] = []
+        cur = dst
+        while prev[cur] is not None:
+            p, lid = prev[cur]  # type: ignore[misc]
+            links.append(lid)
+            cur = p
+        links.reverse()
+        self._detour_cache[key] = links
+        return links
+
     def _route(self, src: int, dst: int, hint: int = -1) -> list[int]:
         """Directed link ids along the dimension-order route src->dst;
-        ``hint`` (axis*2+dir) forces the rotation direction on one axis."""
+        ``hint`` (axis*2+dir) forces the rotation direction on one axis.
+        On a faulted topology, a route crossing a dead link is replaced
+        by the shortest live detour (ignoring the hint — a forced
+        rotation through a dead cable is meaningless)."""
         topo = self.topo
         nd = topo.ndims
         links: list[int] = []
@@ -208,6 +289,10 @@ class TorusNetwork:
                 step = 1 if direction == 0 else -1
                 cc[axis] = (cc[axis] + step) % d
                 cur = topo.chip_at(tuple(cc))
+        if self._faulted and links and any(
+            not topo.link_alive(*self._link_endpoints(lid)) for lid in links
+        ):
+            return self._route_around(src, dst)
         return links
 
     def _run_python(
@@ -235,16 +320,19 @@ class TorusNetwork:
                     seq += 1
             link_free: dict[int, float] = {}
             phase_end = 0.0
+            faulted = self._faulted
             while heap:
                 t, _, pid = heapq.heappop(heap)
                 links, pos, ser = pkts[pid]
                 lid = links[pos]
+                # a degraded link serializes the same flits more slowly
+                ser_l = ser / self._lid_scale(lid) if faulted else ser
                 depart = max(t, link_free.get(lid, 0.0))
-                link_free[lid] = depart + ser
+                link_free[lid] = depart + ser_l
                 arrive = depart + self.hop_cycles
                 pkts[pid][1] = pos + 1
                 if pos + 1 >= len(links):
-                    phase_end = max(phase_end, arrive + ser)
+                    phase_end = max(phase_end, arrive + ser_l)
                 else:
                     heapq.heappush(heap, (arrive, seq, pid))
                     seq += 1
@@ -569,7 +657,9 @@ class DetailedCollectiveModel:
         See the class docstring for the multiplicity caveat — only the
         busy/capacity ratio is meaningful, not the absolutes."""
         busy = 0.0
-        links: set[int] = set()
+        faulted = self.net._faulted
+        per_link: dict[int, float] = {}
+        degraded_busy = 0.0
         for phase in phases:
             for tr in phase:
                 src, dst, nbytes = int(tr[0]), int(tr[1]), float(tr[2])
@@ -577,15 +667,41 @@ class DetailedCollectiveModel:
                     continue
                 hint = int(tr[3]) if len(tr) > 3 else -1
                 route = self.net._route(src, dst, hint)
-                busy += (nbytes / self.net.flit_bytes) * len(route)
-                links.update(route)
+                ser = nbytes / self.net.flit_bytes
+                for lid in route:
+                    if faulted:
+                        scale = self.net._lid_scale(lid)
+                        b = ser / scale
+                        if scale < 1.0:
+                            degraded_busy += b
+                    else:
+                        b = ser
+                    busy += b
+                    per_link[lid] = per_link.get(lid, 0.0) + b
         obs = self.obs
         obs.counter_add("ici.detailed.priced_collectives", 1)
         obs.counter_add(f"ici.detailed.priced_{info.kind}_count", 1)
         obs.counter_add("ici.detailed.link_busy_cycles", busy)
         obs.counter_add(
-            "ici.detailed.link_cycle_capacity", len(links) * cycles
+            "ici.detailed.link_cycle_capacity", len(per_link) * cycles
         )
+        if faulted:
+            # degraded-pod visibility: busy attributed to degraded links
+            # plus the per-pricing-call worst link's occupancy (running
+            # max across calls — the schedule's hottest surviving cable)
+            obs.counter_add(
+                "ici.detailed.degraded_link_busy_cycles", degraded_busy
+            )
+            worst = (
+                max(per_link.values()) / cycles
+                if per_link and cycles > 0 else 0.0
+            )
+            prev = getattr(obs, "counters", {}).get(
+                "ici.detailed.worst_link_occupancy", 0.0
+            )
+            obs.counter_set(
+                "ici.detailed.worst_link_occupancy", max(prev, worst)
+            )
 
 
 def make_collective_model(topo: Topology, cfg: "IciConfig", obs=None):

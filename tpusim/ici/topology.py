@@ -11,18 +11,27 @@ axis lengths, wrap-ness, and hop distances.
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Iterator
 
 __all__ = ["Topology", "torus_for"]
 
 
 @dataclass(frozen=True)
 class Topology:
-    """An N-dimensional (1..3) torus/mesh of chips."""
+    """An N-dimensional (1..3) torus/mesh of chips.
+
+    ``faults`` optionally carries a :class:`tpusim.faults.FaultView`
+    (attached via :meth:`with_faults`); the link-liveness queries below
+    forward to it and are trivially True/1.0 on a healthy topology, so
+    fault awareness costs the healthy path nothing.  Excluded from
+    eq/hash: a faulted topology is the same *shape*."""
 
     dims: tuple[int, ...]            # e.g. (4, 4, 4) for v5p-128 (64 chips)
     wrap: tuple[bool, ...]           # per-axis wraparound links present?
+    faults: object | None = field(default=None, compare=False)
 
     def __post_init__(self):
         if len(self.dims) != len(self.wrap):
@@ -81,6 +90,68 @@ class Topology:
         other = self.num_chips // self.dims[longest]
         per_cut = other * (2 if self.wrap[longest] else 1)
         return max(per_cut, 1)
+
+    # -- link enumeration / liveness (tpusim.faults) -----------------------
+
+    def neighbor(self, chip: int, axis: int, direction: int) -> int | None:
+        """Chip one hop from ``chip`` along ``axis`` (direction 0 = +1,
+        1 = -1); None at a mesh edge without a wrap link."""
+        c = list(self.coords(chip))
+        step = 1 if direction == 0 else -1
+        nxt = c[axis] + step
+        if not self.wrap[axis] and not 0 <= nxt < self.dims[axis]:
+            return None
+        c[axis] = nxt % self.dims[axis]
+        return self.chip_at(tuple(c))
+
+    def directed_links(self) -> Iterator[tuple[int, int, int, int]]:
+        """Every directed ICI link as ``(src, dst, axis, direction)``.
+        A wrapped length-2 axis yields both directions between the same
+        chip pair — two physical cables, like real v5p wiring."""
+        for chip in range(self.num_chips):
+            for axis in range(self.ndims):
+                if self.dims[axis] <= 1:
+                    continue
+                for direction in (0, 1):
+                    dst = self.neighbor(chip, axis, direction)
+                    if dst is not None:
+                        yield (chip, dst, axis, direction)
+
+    def undirected_links(self) -> list[tuple[int, int]]:
+        """Unique chip pairs carrying at least one link (the sweep grain
+        of ``tpusim.faults.sweep``)."""
+        seen: set[tuple[int, int]] = set()
+        for src, dst, _, _ in self.directed_links():
+            seen.add((min(src, dst), max(src, dst)))
+        return sorted(seen)
+
+    def with_faults(self, view) -> "Topology":
+        """This topology shape with a fault view attached (None clears)."""
+        return dataclasses.replace(self, faults=view)
+
+    @property
+    def has_faults(self) -> bool:
+        return self.faults is not None
+
+    def link_alive(self, src: int, dst: int) -> bool:
+        """Is the directed link ``src -> dst`` up?  (True when no fault
+        view is attached — the healthy default.)"""
+        return self.faults is None or self.faults.link_alive(src, dst)
+
+    def link_scale(self, src: int, dst: int) -> float:
+        """Bandwidth multiplier of the directed link (1.0 = healthy)."""
+        return 1.0 if self.faults is None else self.faults.link_scale(src, dst)
+
+    def axis_ring_intact(self, axis: int) -> bool:
+        """Can the counter-rotating ring schedule still run on ``axis``?
+        Any dead link along the axis breaks the ring (traffic must
+        route around), so the schedule math falls back to mesh terms."""
+        if not self.wrap[axis]:
+            return False
+        return (
+            self.faults is None
+            or axis not in self.faults.broken_axes
+        )
 
 
 def torus_for(num_chips: int, generation: str = "v5p") -> Topology:

@@ -118,6 +118,7 @@ class SimDriver:
         config: SimConfig,
         topology: Topology | None = None,
         obs=None,
+        faults=None,
     ):
         self.config = config
         self.arch = config.arch
@@ -125,6 +126,9 @@ class SimDriver:
         # instrumentation hub (tpusim.obs); the no-op default adds no
         # stats keys and no per-command work
         self.obs = obs if obs is not None else NULL_OBS
+        # fault schedule (tpusim.faults.FaultSchedule | path | dict);
+        # None = healthy pod, zero added work and zero added stats keys
+        self.faults = faults
 
     # ------------------------------------------------------------------
 
@@ -139,9 +143,61 @@ class SimDriver:
             len(pod.devices) or 1,
         )
         obs = self.obs
-        topo = self.topology or torus_for(n_devices, arch.name)
+        base_topo = self.topology or torus_for(n_devices, arch.name)
+        # fault binding: resolve the schedule against this pod's topology
+        # once (validates coords/adjacency), then attach the cycle-0 view.
+        # Windowed schedules re-resolve the view at each command's issue
+        # cycle — kernels pick their chip multipliers and standalone
+        # collectives their link view at command grain (a fault window
+        # cannot split a single kernel: the whole launch prices under
+        # the view active when it issues).
+        fault_state = None
+        fault_view = None
+        if self.faults is not None:
+            from tpusim.faults import FaultSchedule, load_fault_schedule
+
+            sched = (
+                self.faults if isinstance(self.faults, FaultSchedule)
+                else load_fault_schedule(self.faults)
+            )
+            fault_state = sched.bind(base_topo)
+            fault_view = fault_state.view_at(0.0)
+        topo = (
+            base_topo.with_faults(fault_view) if fault_view is not None
+            else base_topo
+        )
         coll = make_collective_model(topo, arch.ici, obs=obs)
         engine = Engine(cfg, topology=topo, obs=obs)
+
+        # degraded chips run their own engine (straggler clock / HBM
+        # throttle multipliers); the healthy class is the default engine
+        engines: dict[tuple[float, float], Engine] = {(1.0, 1.0): engine}
+
+        def engine_for(scales: tuple[float, float]) -> Engine:
+            e = engines.get(scales)
+            if e is None:
+                e = engines[scales] = Engine(
+                    cfg, topology=topo, obs=obs,
+                    clock_scale=scales[0], hbm_scale=scales[1],
+                )
+            return e
+
+        # windowed link faults: standalone collectives are priced with
+        # the view active at their issue cycle (models cached per view)
+        coll_models = {
+            (fault_view.signature if fault_view is not None else None): coll
+        }
+
+        def coll_for(cycle: float):
+            if fault_state is None or not fault_state.windowed:
+                return coll
+            v = fault_state.view_at(cycle)
+            m = coll_models.get(v.signature)
+            if m is None:
+                m = coll_models[v.signature] = make_collective_model(
+                    base_topo.with_faults(v), arch.ici, obs=obs
+                )
+            return m
 
         report = SimReport(
             config_name=arch.name, num_devices=n_devices,
@@ -154,19 +210,27 @@ class SimDriver:
 
         # Kernel timing is per-module (SPMD: all devices run the same
         # program) — cache engine results like the reference caches parsed
-        # kernel traces per launch (trace_driven.cc:540-586).
-        module_results: dict[str, EngineResult] = {}
+        # kernel traces per launch (trace_driven.cc:540-586).  Degraded
+        # chips (stragglers / HBM throttles) form their own cache class:
+        # the same module re-times under that chip's multipliers.
+        module_results: dict[tuple[str, tuple[float, float]], EngineResult] \
+            = {}
 
-        def module_result(name: str) -> EngineResult:
-            if name not in module_results:
+        def module_result(
+            name: str, scales: tuple[float, float] = (1.0, 1.0)
+        ) -> EngineResult:
+            key = (name, scales)
+            if key not in module_results:
                 if name not in pod.modules:
                     raise KeyError(
                         f"command references unknown module {name!r}; "
                         f"trace has {sorted(pod.modules)}"
                     )
                 with obs.span("engine"):
-                    module_results[name] = engine.run(pod.modules[name])
-            return module_results[name]
+                    module_results[key] = engine_for(scales).run(
+                        pod.modules[name]
+                    )
+            return module_results[key]
 
         # Cross-device collective rendezvous: the k-th standalone collective
         # *over a given replica group* must align across that group's
@@ -200,6 +264,19 @@ class SimDriver:
             dev = pod.devices.get(dev_id)
             if dev is None:
                 continue
+            dev_scales = (
+                fault_view.chip_scales(dev_id)
+                if fault_view is not None else (1.0, 1.0)
+            )
+
+            def scales_at(cycle: float) -> tuple[float, float]:
+                """Chip multipliers for this device at a kernel's issue
+                cycle — windowed stragglers/throttles hit only the
+                launches their window overlaps."""
+                if fault_state is None or not fault_state.windowed:
+                    return dev_scales
+                return fault_state.view_at(cycle).chip_scales(dev_id)
+
             coll_counts: Counter = Counter()  # per-group issue index
             kernel_index = 0
             # completion times of this device's kernel launches, in launch
@@ -239,7 +316,10 @@ class SimDriver:
                     break
 
                 if is_kernel:
-                    res = module_result(cmd.module)
+                    res = module_result(
+                        cmd.module,
+                        scales_at(max(ready, core_free[dev_id])),
+                    )
                     start = max(ready, core_free[dev_id])
                     dur = res.cycles
                     end = start + dur
@@ -269,7 +349,9 @@ class SimDriver:
 
                 elif cmd.kind == CommandKind.COLLECTIVE and cmd.collective:
                     with obs.span("ici"):
-                        secs = coll.seconds(
+                        secs = coll_for(
+                            max(ready, ici_free[dev_id])
+                        ).seconds(
                             cmd.collective, float(cmd.nbytes)
                         )
                     dur = arch.seconds_to_cycles(secs)
@@ -358,14 +440,16 @@ class SimDriver:
             launches = Counter(k.module for k in report.kernels)
             worst = sorted(
                 module_results.items(),
-                key=lambda kv: -(kv[1].cycles * max(launches.get(kv[0], 0), 1)),
+                key=lambda kv: -(
+                    kv[1].cycles * max(launches.get(kv[0][0], 0), 1)
+                ),
             )[:3]
             report.stats.set(
                 "deadlock_suspects",
                 ";".join(
                     f"{name}:x{max(launches.get(name, 0), 1)}:"
                     f"{r.cycles * max(launches.get(name, 0), 1):.3g}cy"
-                    for name, r in worst
+                    for (name, _), r in worst
                 ),
             )
 
@@ -387,6 +471,18 @@ class SimDriver:
                         pod_samples.add(unit, s0, s1, ici_bytes=nbytes)
                     else:
                         pod_samples.add(unit, s0, s1, hbm_bytes=nbytes)
+                if fault_state is not None and report.cycles > 0:
+                    # each active fault contributes its overlap cycles to
+                    # the "faults" lane; window_rows divides by the window
+                    # to recover the avg active-fault count per window
+                    # (the faults_active counter track)
+                    for f0, f1 in fault_state.intervals():
+                        s0 = max(f0, 0.0)
+                        s1 = min(f1, report.cycles)
+                        if s1 > s0:
+                            pod_samples.add(
+                                "faults", s0, s1, op_count=0.0
+                            )
                 report.samples = pod_samples
                 obs.counter_set("samples.windows", pod_samples.num_windows)
                 obs.counter_set(
@@ -395,6 +491,17 @@ class SimDriver:
 
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)
+        if fault_state is not None:
+            # faults_* keys ride the report ONLY when a schedule is
+            # active — the healthy path stays key-identical to PR 1.
+            # Counts describe the whole schedule (windowed faults
+            # included), not just the cycle-0 snapshot.
+            report.stats.update(fault_state.full_view().stats_dict())
+            worst_occ = getattr(obs, "counters", {}).get(
+                "ici.detailed.worst_link_occupancy"
+            )
+            if worst_occ is not None:
+                report.stats.set("faults_worst_link_occupancy", worst_occ)
         if cfg.power_enabled:
             from tpusim.power.model import PowerModel
 
@@ -418,6 +525,9 @@ def simulate_trace(
     overlays: list[Any] | None = None,
     tuned: bool = True,
     obs=None,
+    faults=None,
+    topology: Topology | None = None,
+    lenient: bool = False,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -426,13 +536,16 @@ def simulate_trace(
     overlay — golden regression sims pin it off so their stats don't
     shift when a live run refreshes the fit.  ``obs`` is an
     :class:`tpusim.obs.hub.Instrumentation` for spans + cycle-window
-    sampling (None = the no-op hub)."""
+    sampling (None = the no-op hub).  ``faults`` is a fault schedule
+    (``tpusim.faults.FaultSchedule`` / path / dict — the ``--faults``
+    flag); ``lenient`` tolerates malformed HLO lines during parse (the
+    ``--lenient-parse`` flag)."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
     obs = obs if obs is not None else NULL_OBS
     with obs.span("parse"):
-        pod = load_trace(trace_path)
+        pod = load_trace(trace_path, lenient=lenient)
     if arch is None and config is None:
         # default the arch to the one the trace was captured on, via the
         # named-preset route so the committed tuner overlay applies
@@ -444,4 +557,6 @@ def simulate_trace(
     with obs.span("config"):
         cfg = load_config(config, arch=arch, overlays=overlays, tuned=tuned)
     with obs.span("simulate"):
-        return SimDriver(cfg, obs=obs).run(pod)
+        return SimDriver(
+            cfg, topology=topology, obs=obs, faults=faults
+        ).run(pod)

@@ -397,6 +397,8 @@ class Engine:
         record_timeline: bool = False,
         max_timeline_events: int = 100_000,
         obs=None,
+        clock_scale: float = 1.0,
+        hbm_scale: float = 1.0,
     ):
         self.config = config
         self.arch = config.arch
@@ -407,6 +409,19 @@ class Engine:
         # instrumentation hub (tpusim.obs); the no-op default keeps the
         # hot path to one cached boolean check per op
         self.obs = obs if obs is not None else NULL_OBS
+        # degraded-chip multipliers (tpusim.faults): a straggler runs its
+        # core/vmem at clock_scale x nominal, a throttled HBM streams at
+        # hbm_scale x nominal.  Cycles stay in NOMINAL units (the pod
+        # clock), so a straggler's ops take 1/clock_scale more of them;
+        # 1.0/1.0 keeps the healthy path bit-identical (no per-op branch)
+        if not 0.0 < clock_scale <= 1.0 or not 0.0 < hbm_scale <= 1.0:
+            raise ValueError(
+                "clock_scale/hbm_scale must be in (0, 1] "
+                f"(got {clock_scale}, {hbm_scale})"
+            )
+        self.clock_scale = float(clock_scale)
+        self.hbm_scale = float(hbm_scale)
+        self._degraded = clock_scale != 1.0 or hbm_scale != 1.0
 
     @staticmethod
     def _peak_live_of(module: ModuleTrace) -> float:
@@ -647,6 +662,30 @@ class Engine:
                 cost_calls += 1
             else:
                 cost = self.cost.op_cost(op, comp, module)
+
+            # ---- degraded chip (tpusim.faults): straggler/HBM throttle -
+            # (free ops — parameter/tuple/bitcast — cost 0 and stay 0:
+            # there is no work to slow down)
+            if self._degraded and cost.cycles > 0:
+                cs, hs = self.clock_scale, self.hbm_scale
+                # core + vmem run on the chip clock; HBM is derated
+                # independently.  Cycles are nominal, so slower silicon
+                # means MORE nominal cycles; the max() keeps floors
+                # (dispatch, small-kernel) monotone under degradation.
+                cost.compute_cycles /= cs
+                cost.hbm_rate_scale *= hs
+                cost.vmem_rate_scale *= cs
+                cost.mem_cycles = max(
+                    cost.hbm_bytes / (hbm_bpc * cost.hbm_rate_scale),
+                    cost.vmem_bytes
+                    / (a.vmem_bytes_per_cycle * cost.vmem_rate_scale),
+                )
+                cost.cycles = max(
+                    cost.cycles,
+                    a.op_overhead_cycles / cs + max(
+                        cost.compute_cycles, cost.mem_cycles
+                    ),
+                )
 
             # ---- vmem capacity: spill the over-subscribed fraction -----
             if spill_frac < 1.0 and cost.vmem_bytes > 0:

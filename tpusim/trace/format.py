@@ -203,8 +203,15 @@ def select_module(pod: "PodTrace", want: str | None):
     )
 
 
-def load_trace(path: str | Path) -> PodTrace:
-    """Load a trace directory into a :class:`PodTrace` (modules parsed)."""
+def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
+    """Load a trace directory into a :class:`PodTrace` (modules parsed).
+
+    ``lenient=True`` parses module text in salvage mode (malformed lines
+    skipped with a counted warning — the ``--lenient-parse`` flag);
+    strict parsing, which raises on the first corrupt line, stays the
+    default.  Lenient mode always parses eagerly in Python: per-line
+    recovery needs the reference parser, not the native scanner or the
+    lazy span index."""
     path = Path(path)
     if not path.is_dir():
         raise FileNotFoundError(f"trace directory not found: {path}")
@@ -236,7 +243,11 @@ def load_trace(path: str | Path) -> PodTrace:
         for key, text in entries:
             # large modules parse lazily: the engine only materializes the
             # computations its schedule walk actually reaches
-            if len(text) >= LAZY_THRESHOLD_BYTES:
+            if lenient:
+                from tpusim.trace.hlo_text import parse_hlo_module
+
+                mod = parse_hlo_module(text, name_hint=key, strict=False)
+            elif len(text) >= LAZY_THRESHOLD_BYTES:
                 mod = parse_hlo_module_lazy(text, name_hint=key)
             else:
                 mod = parse_hlo_module_fast(text, name_hint=key)

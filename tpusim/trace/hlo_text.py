@@ -433,16 +433,28 @@ def parse_module_attrs(attr_text: str, meta: dict) -> None:
             meta[key] = val == "true"
 
 
-def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
+def parse_hlo_module(
+    text: str, name_hint: str = "module", strict: bool = True
+) -> ModuleTrace:
     """Parse a full HLO module text dump into a :class:`ModuleTrace`.
 
     Accepts the output of ``compiled.as_text()`` (scheduled, optimized TPU
     HLO with layouts) as well as unoptimized ``lowered.as_text()`` dumps and
     hand-written fixtures.  Trailing sections (e.g. the ``FileLocations`` /
     ``StackFrames`` tables emitted by newer XLA) are ignored.
+
+    ``strict=False`` is the salvage mode for flaky captures: a malformed
+    instruction line (truncated write, corrupted shape, unbalanced
+    delimiters) is SKIPPED with a counted warning instead of raising
+    mid-file — one corrupt line no longer loses a whole multi-GB trace.
+    The skip count lands in ``module.meta['parse_skipped_lines']`` and a
+    single ``UserWarning`` summarizes the damage.  Strict (raising)
+    parsing remains the default: silent data loss must be opted into.
     """
     module = ModuleTrace(name=name_hint)
     current: Computation | None = None
+    skipped = 0
+    first_error: str | None = None
 
     for raw in text.splitlines():
         line = raw.rstrip()
@@ -480,10 +492,31 @@ def parse_hlo_module(text: str, name_hint: str = "module") -> ModuleTrace:
                 module.add_computation(current)
                 current = None
                 continue
-            op = parse_instruction(stripped)
+            try:
+                op = parse_instruction(stripped)
+            except ValueError as e:
+                if strict:
+                    raise ValueError(
+                        f"{name_hint}: malformed HLO line "
+                        f"{stripped[:120]!r}: {e}"
+                    ) from e
+                skipped += 1
+                if first_error is None:
+                    first_error = f"{stripped[:80]!r}: {e}"
+                continue
             if op is not None:
                 current.add(op)
 
     if current is not None:  # unterminated last computation (tolerate)
         module.add_computation(current)
+    if skipped:
+        import warnings
+
+        module.meta["parse_skipped_lines"] = skipped
+        warnings.warn(
+            f"lenient HLO parse of {module.name!r}: skipped {skipped} "
+            f"malformed line(s); first: {first_error}",
+            UserWarning,
+            stacklevel=2,
+        )
     return module

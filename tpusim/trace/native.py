@@ -162,8 +162,15 @@ def _build_op(fields: list[str]) -> TraceOp:
     )
 
 
-def parse_hlo_module_fast(text: str, name_hint: str = "module") -> ModuleTrace:
-    """Native parse when the library is built, Python otherwise."""
-    if native_available():
+def parse_hlo_module_fast(
+    text: str, name_hint: str = "module", strict: bool = True
+) -> ModuleTrace:
+    """Native parse when the library is built, Python otherwise.
+
+    ``strict=False`` (skip malformed lines with a counted warning) always
+    takes the Python path: the C++ scanner's record stream has no
+    per-line error recovery, and salvage mode is for damaged captures
+    where robustness beats speed."""
+    if strict and native_available():
         return parse_hlo_module_native(text, name_hint)
-    return pyparse.parse_hlo_module(text, name_hint)
+    return pyparse.parse_hlo_module(text, name_hint, strict=strict)
