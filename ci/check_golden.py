@@ -236,6 +236,84 @@ def faults_smoke() -> dict:
     }
 
 
+def lint_smoke() -> dict:
+    """Static-analyzer contract smoke (``tpusim lint`` over everything
+    checked in):
+
+    1. every fixture trace under the golden matrix's arches must lint
+       with ZERO error-level diagnostics (warnings allowed — CPU-backend
+       capture quirks are warnings by design);
+    2. every committed overlay flag file must compose onto its arch and
+       pass the config passes clean;
+    3. every example schedule in ``ci/faults_schema.json`` must pass the
+       schedule passes against a 4x4x4 v5p torus;
+    4. the repo-wide stats-key audit must be clean;
+    5. ``--list-codes`` must agree with the registry (docs/CI sync).
+    Raises on violation."""
+    from tpusim.analysis import (
+        CODES, Diagnostics, analyze_stats_keys, list_code_lines,
+    )
+    from tpusim.analysis.runner import analyze_config, analyze_schedule
+    from tpusim.analysis.trace_passes import (
+        load_parsed_trace, run_trace_passes,
+    )
+    from tpusim.ici.topology import torus_for
+    from tpusim.timing.config import load_config
+
+    checked: list[str] = []
+
+    def _require_clean(diags, what: str) -> None:
+        if diags.has_errors:
+            lines = "\n".join(d.text() for d in diags.errors)
+            raise ValueError(
+                f"lint smoke: {what} has error-level diagnostics:\n"
+                f"{lines}"
+            )
+        checked.append(what)
+
+    fixtures = sorted({m[0] for m in MATRIX})
+    arches = sorted({m[1] for m in MATRIX})
+    for fixture in fixtures:
+        # trace passes are arch-independent: parse + lint the artifacts
+        # once, then rerun only the config passes per matrix arch
+        pt = load_parsed_trace(FIXTURES / fixture)
+        diags = Diagnostics()
+        run_trace_passes(pt, diags, lenient=False)
+        _require_clean(diags, f"trace {fixture}")
+        for arch in arches:
+            cfg = load_config(arch=arch, tuned=False)
+            _require_clean(
+                analyze_config(cfg, trace_meta=pt.meta),
+                f"config passes {fixture} @ {arch}",
+            )
+
+    for flags in sorted((REPO / "configs").glob("*.flags")):
+        arch = flags.name.split(".", 1)[0]
+        cfg = load_config(arch=arch, overlays=[flags], tuned=False)
+        _require_clean(
+            analyze_config(cfg, file=f"configs/{flags.name}"),
+            f"config {flags.name}",
+        )
+
+    schema = json.loads(FAULTS_SCHEMA.read_text())
+    topo = torus_for(64, "v5p")
+    for kind, doc in sorted(schema.get("example_schedules", {}).items()):
+        _require_clean(
+            analyze_schedule(doc, topo),
+            f"schedule example {kind}",
+        )
+
+    _require_clean(analyze_stats_keys(), "stats-key audit")
+
+    lines = list_code_lines()
+    if len(lines) != len(CODES):
+        raise ValueError(
+            f"lint smoke: --list-codes prints {len(lines)} lines but "
+            f"the registry has {len(CODES)} codes"
+        )
+    return {"artifacts": checked, "codes": len(CODES)}
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -247,7 +325,22 @@ def main(argv: list[str] | None = None) -> int:
                     help="validate the fault-schedule contract against "
                          "ci/faults_schema.json: one-dead-link replay "
                          "of a tiny v5p slice + stats-key check")
+    ap.add_argument("--lint-smoke", action="store_true",
+                    help="run tpusim lint over every checked-in golden "
+                         "trace/config/fault-schedule and require zero "
+                         "error-level diagnostics")
     args = ap.parse_args(argv)
+
+    if args.lint_smoke:
+        try:
+            summary = lint_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --lint-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --lint-smoke: OK "
+              f"({len(summary['artifacts'])} artifacts lint clean, "
+              f"{summary['codes']} diagnostic codes registered)")
+        return 0
 
     if args.faults_smoke:
         try:

@@ -5,45 +5,56 @@
 # multi-config matrix).  tpusim's tiers:
 #
 #   1. build   — native components (the `make` of accel-sim.out)
-#   2. unit    — pytest fast tier (the improvement over the reference's
+#   2. lint    — repo-wide static analysis (ruff when installed, the
+#                stdlib fallback in ci/lint_repo.py otherwise)
+#   3. unit    — pytest fast tier (the improvement over the reference's
 #                CI-only testing, SURVEY.md §4)
-#   3. golden  — simulate committed fixture traces across a config matrix,
+#   4. golden  — simulate committed fixture traces across a config matrix,
 #                diff every stat against ci/golden/ (the prebuilt-trace
 #                regression sims)
-#   4. obs     — simulate a golden fixture with the observability layer
+#   5. obs     — simulate a golden fixture with the observability layer
 #                on; validate the emitted samples JSONL / Chrome trace /
 #                prometheus text against ci/obs_schema.json
-#   5. faults  — degraded-pod smoke: replay a tiny v5p slice with one
+#   6. faults  — degraded-pod smoke: replay a tiny v5p slice with one
 #                dead ICI link; check the fault-schedule contract and
 #                faults_* stat keys against ci/faults_schema.json
-#   6. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#   7. tlint   — trace/config/schedule lint smoke: `tpusim lint` over
+#                every checked-in golden artifact must report zero
+#                error-level diagnostics (ci/check_golden --lint-smoke)
+#   8. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-5
+# Usage:  bash ci/run_ci.sh            # tiers 1-7
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/6] build native ==="
+echo "=== [1/8] build native ==="
 make -C native
 
-echo "=== [2/6] unit tests (fast tier) ==="
+echo "=== [2/8] repo static analysis (ruff / stdlib fallback) ==="
+python ci/lint_repo.py
+
+echo "=== [3/8] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [3/6] golden-stat regression sims ==="
+echo "=== [4/8] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [4/6] obs export smoke (schema-checked) ==="
+echo "=== [5/8] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [5/6] faults smoke (degraded-pod contract) ==="
+echo "=== [6/8] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
+echo "=== [7/8] trace/config/schedule lint smoke ==="
+python ci/check_golden.py --lint-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [6/6] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [8/8] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [6/6] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [8/8] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -2,7 +2,7 @@
 
 import pytest
 
-from tpusim.timing import ARCH_PRESETS, arch_preset
+from tpusim.timing import arch_preset
 from tpusim.timing.arch import detect_arch
 from tpusim.timing.config import (
     ArchConfig,

@@ -16,7 +16,7 @@ import pytest
 from tests.conftest import run_in_cpu_mesh
 from tpusim.ir import CollectiveInfo, CommandKind, PodTrace, TraceCommand
 from tpusim.sim.driver import SimDriver
-from tpusim.timing.config import SimConfig, load_config
+from tpusim.timing.config import load_config
 
 
 # -- config #2: two-chip all-reduce example ---------------------------------

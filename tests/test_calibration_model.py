@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import pytest
 
-from tpusim.ir import Unit
 from tpusim.timing.config import ArchConfig, SimConfig, overlay
 from tpusim.timing.cost import CostModel
 from tpusim.timing.engine import Engine

@@ -5,7 +5,7 @@ from pathlib import Path
 import pytest
 
 from tpusim.ir import Unit
-from tpusim.timing.config import SimConfig, overlay
+from tpusim.timing.config import SimConfig
 from tpusim.timing.cost import CostModel, dot_dims, while_trip_count
 from tpusim.timing.engine import Engine
 from tpusim.trace.hlo_text import parse_hlo_module, parse_instruction

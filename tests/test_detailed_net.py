@@ -15,7 +15,7 @@ from tpusim.ici.detailed import (
     NET_CYCLE_S,
 )
 from tpusim.ici.collectives import CollectiveModel
-from tpusim.ici.topology import Topology, torus_for
+from tpusim.ici.topology import Topology
 from tpusim.ir import CollectiveInfo
 from tpusim.timing.config import IciConfig
 

@@ -6,7 +6,6 @@ from pathlib import Path
 import pytest
 
 from tpusim.sim.interval import (
-    IntervalSample,
     read_interval_log,
     render_text_lanes,
     sample_intervals,

@@ -16,7 +16,7 @@ from tpusim.timing.config import SimConfig
 from tpusim.timing.engine import Engine
 from tpusim.trace.format import load_trace, save_trace
 from tpusim.trace.hlo_text import parse_hlo_module
-from tpusim.trace.lazy import LazyModuleTrace, parse_hlo_module_lazy
+from tpusim.trace.lazy import parse_hlo_module_lazy
 
 FIXTURES = Path(__file__).parent / "fixtures"
 

@@ -1,0 +1,728 @@
+"""tpusim.analysis — the static analyzer's seeded-defect corpus.
+
+One deliberately broken trace / config / schedule per diagnostic code,
+asserting each code fires exactly where expected (file:line anchors
+included), plus: registry coverage (every code in CODES is triggered by
+at least one seeded defect), JSON-output round-trip, ``--list-codes``
+sync, CLI exit codes, and the ``simulate --validate`` refusal path.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.analysis import (
+    CODES,
+    Diagnostics,
+    Severity,
+    analyze_schedule,
+    analyze_stats_keys,
+    analyze_trace_dir,
+    list_code_lines,
+)
+from tpusim.ici.topology import torus_for
+
+# ---------------------------------------------------------------------------
+# Corpus builders
+# ---------------------------------------------------------------------------
+
+GOOD_HLO = """HloModule good, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %r = f32[8]{0} negate(%p0)
+}
+"""
+
+
+def make_trace(
+    tmp_path: Path,
+    hlo: str = GOOD_HLO,
+    name: str = "good",
+    commands: list | None = None,
+    raw_commands: list[str] | None = None,
+    meta: dict | str | None = None,
+) -> Path:
+    root = tmp_path / "trace"
+    (root / "modules").mkdir(parents=True)
+    (root / "modules" / f"{name}.hlo").write_text(hlo)
+    if meta is None:
+        meta = {"num_devices": 4, "device_kind": "cpu"}
+    (root / "meta.json").write_text(
+        meta if isinstance(meta, str) else json.dumps(meta)
+    )
+    lines = [json.dumps(c) for c in (
+        commands if commands is not None
+        else [{"kind": "kernel_launch", "module": name, "device": 0}]
+    )]
+    lines += raw_commands or []
+    (root / "commandlist.jsonl").write_text("\n".join(lines) + "\n")
+    return root
+
+
+def _trace_defect(hlo: str, name: str = "bad", **kw):
+    def build(tmp_path: Path) -> Diagnostics:
+        return analyze_trace_dir(
+            make_trace(tmp_path, hlo=hlo, name=name, **kw),
+            arch="v5e", tuned=False,
+        )
+    return build
+
+
+def _cmd_defect(commands=None, raw=None, meta=None):
+    def build(tmp_path: Path) -> Diagnostics:
+        return analyze_trace_dir(
+            make_trace(
+                tmp_path, commands=commands, raw_commands=raw, meta=meta,
+            ),
+            arch="v5e", tuned=False,
+        )
+    return build
+
+
+def _config_defect(overlay: dict, meta: dict | None = None):
+    def build(tmp_path: Path) -> Diagnostics:
+        return analyze_trace_dir(
+            make_trace(tmp_path, meta=meta),
+            arch="v5e", overlays=[overlay], tuned=False,
+        )
+    return build
+
+
+def _schedule_defect(doc: dict):
+    def build(tmp_path: Path) -> Diagnostics:
+        return analyze_schedule(doc, torus_for(64, "v5p"))
+    return build
+
+
+def _statskey_defect(files: dict[str, str], schema: dict | None = None):
+    """Seed a miniature repo with the audited layout and run the
+    stats-key contract pass against it."""
+    def build(tmp_path: Path) -> Diagnostics:
+        root = tmp_path / "repo"
+        defaults = {
+            "tpusim/sim/stats.py": "", "tpusim/sim/driver.py": "",
+            "tpusim/obs/hub.py": "", "tpusim/faults/schedule.py": "",
+            "tpusim/ici/topology.py": "", "tpusim/timing/engine.py": "",
+            "tpusim/__main__.py": "",
+        }
+        defaults.update(files)
+        for rel, text in defaults.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(text)
+        schema_path = root / "ci" / "faults_schema.json"
+        schema_path.parent.mkdir(parents=True, exist_ok=True)
+        schema_path.write_text(json.dumps(
+            schema if schema is not None
+            else {"stats_required_when_active": []}
+        ))
+        return analyze_stats_keys(root=root, schema_path=schema_path)
+    return build
+
+
+#: (name, codes the defect must fire, builder) — the registry-coverage
+#: test asserts the union of `codes` equals the full CODES table.
+SEEDED_DEFECTS = [
+    ("undefined-operand", {"TL001"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %r = f32[8]{0} add(%p0, %ghost)
+}
+""")),
+    ("use-before-def", {"TL002"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %a = f32[8]{0} add(%p0, %b)
+  %b = f32[8]{0} negate(%p0)
+  ROOT %r = f32[8]{0} add(%a, %b)
+}
+""")),
+    ("arity", {"TL003"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %r = f32[8]{0} negate(%p0, %p0)
+}
+""")),
+    ("shape-mismatch", {"TL004"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[4] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %r = f32[4]{0} multiply(%p0, %p0)
+}
+""")),
+    ("while-shape", {"TL005"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+%cond (t: f32[8]) -> pred[] {
+  %t = f32[8]{0} parameter(0)
+  ROOT %lt = pred[] constant(true)
+}
+
+%body (t2: f32[8]) -> f32[4] {
+  %t2 = f32[8]{0} parameter(0)
+  ROOT %s = f32[4]{0} slice(%t2), slice={[0:4]}
+}
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %w = f32[8]{0} while(%p0), condition=%cond, body=%body
+}
+""")),
+    ("unknown-module", {"TL006"}, _cmd_defect(commands=[
+        {"kind": "kernel_launch", "module": "good", "device": 0},
+        {"kind": "kernel_launch", "module": "nope", "device": 0},
+    ])),
+    ("device-range", {"TL007"}, _cmd_defect(commands=[
+        {"kind": "kernel_launch", "module": "good", "device": 9},
+    ])),
+    ("collective-bytes", {"TL008"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[16] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %ar = f32[16]{0} all-reduce(%p0), replica_groups={{0,1},{2,3}}
+}
+""")),
+    ("replica-group-range", {"TL009"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %ar = f32[8]{0} all-reduce(%p0), replica_groups={{0,1},{2,7}}
+}
+""")),
+    ("commandlist-syntax", {"TL010"}, _cmd_defect(
+        raw=["{not json", '{"kind": "warp_launch"}'],
+    )),
+    ("meta-syntax", {"TL010"}, _cmd_defect(meta="{broken")),
+    ("no-entry", {"TL011"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+%helper (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %r = f32[8]{0} negate(%p0)
+}
+""")),
+    ("parse-skipped", {"TL012"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %x = f32[8]{0} add(%p0, %p0 qq
+  ROOT %r = f32[8]{0} negate(%p0)
+}
+""")),
+    ("missing-called", {"TL013"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %f = f32[8]{0} fusion(%p0), kind=kLoop, calls=%gone
+}
+""")),
+    ("group-tiling", {"TL014"}, _trace_defect(
+        """HloModule bad, num_partitions=4
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %ar = f32[8]{0} all-reduce(%p0), replica_groups={{0,1}}
+}
+""")),
+    ("zero-byte-collective", {"TL015"}, _cmd_defect(commands=[
+        {"kind": "kernel_launch", "module": "good", "device": 0},
+        {"kind": "collective", "device": 0, "bytes": 0,
+         "collective": {"kind": "all-reduce",
+                        "replica_groups": [[0, 1], [2, 3]]}},
+    ])),
+    ("nonpositive-clock", {"TL101"}, _config_defect(
+        {"arch": {"clock_ghz": 0.0}},
+    )),
+    ("roofline", {"TL102"}, _config_defect(
+        {"arch": {"mxu_rows": 12}},
+    )),
+    ("arch-mismatch", {"TL103"}, _config_defect(
+        {}, meta={"num_devices": 4, "device_kind": "TPU v4"},
+    )),
+    ("fraction-range", {"TL104"}, _config_defect(
+        {"arch": {"hbm_efficiency": 1.5}},
+    )),
+    ("bad-enum", {"TL105"}, _config_defect(
+        {"arch": {"ici": {"network_mode": "quantum"}}},
+    )),
+    ("negative-latency", {"TL106"}, _config_defect(
+        {"arch": {"hbm_latency": -1e-6}},
+    )),
+    ("config-compose", {"TL107"}, _config_defect(
+        "/nonexistent/overlay.flags",
+    )),
+    ("schedule-window", {"TL201"}, _schedule_defect(
+        {"faults": [{"kind": "chip_straggler", "chip": 0,
+                     "clock_scale": 0.5,
+                     "start_cycle": 5, "end_cycle": 5}]},
+    )),
+    ("schedule-binding", {"TL202"}, _schedule_defect(
+        {"faults": [{"kind": "link_down",
+                     "src": [0, 0, 0], "dst": [2, 0, 0]}]},
+    )),
+    ("overlapping-faults", {"TL203"}, _schedule_defect(
+        {"faults": [
+            {"kind": "link_degraded", "src": 0, "dst": 1,
+             "bandwidth_scale": 0.5},
+            {"kind": "link_degraded", "src": 1, "dst": 0,
+             "bandwidth_scale": 0.25},
+        ]},
+    )),
+    ("no-effect-scale", {"TL204"}, _schedule_defect(
+        {"faults": [{"kind": "hbm_throttle", "chip": 3,
+                     "hbm_scale": 1.0}]},
+    )),
+    ("statskey-ownership", {"TL301"}, _statskey_defect({
+        "tpusim/timing/engine.py":
+            'def stats_dict(self):\n'
+            '    return {"obs_rogue_key": 1.0}\n',
+    })),
+    ("statskey-prefix", {"TL302"}, _statskey_defect({
+        "tpusim/sim/driver.py":
+            'report.stats.update(d, prefix="zzz_")\n',
+    })),
+    ("statskey-schema", {"TL303"}, _statskey_defect(
+        {}, schema={"stats_required_when_active": ["faults_phantom"]},
+    )),
+]
+
+_IDS = [name for name, _, _ in SEEDED_DEFECTS]
+
+
+@pytest.mark.parametrize(
+    "name, codes, build", SEEDED_DEFECTS, ids=_IDS,
+)
+def test_seeded_defect_fires(name, codes, build, tmp_path):
+    diags = build(tmp_path)
+    fired = diags.codes()
+    assert codes <= fired, (
+        f"{name}: expected {sorted(codes)} ⊆ fired {sorted(fired)}:\n"
+        + "\n".join(diags.text_lines())
+    )
+    # severity of every firing matches the registry default
+    for d in diags.items:
+        assert d.severity is CODES[d.code].severity
+
+
+def test_registry_fully_covered():
+    """Every registered diagnostic code is triggered by at least one
+    seeded defect — a new code without a corpus entry fails here."""
+    covered = set()
+    for _, codes, _ in SEEDED_DEFECTS:
+        covered |= codes
+    assert covered == set(CODES), (
+        f"uncovered codes: {sorted(set(CODES) - covered)}; "
+        f"unknown codes in corpus: {sorted(covered - set(CODES))}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Anchors: findings point at the exact artifact line
+# ---------------------------------------------------------------------------
+
+
+def test_anchor_module_line(tmp_path):
+    hlo = (
+        "HloModule bad, num_partitions=4\n"
+        "\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  ROOT %r = f32[8]{0} add(%p0, %ghost)\n"
+        "}\n"
+    )
+    diags = analyze_trace_dir(
+        make_trace(tmp_path, hlo=hlo, name="bad"),
+        arch="v5e", tuned=False,
+    )
+    (d,) = diags.by_code("TL001")
+    assert d.file == "modules/bad.hlo"
+    assert d.line == 5  # the ROOT %r line
+    assert d.anchor == "modules/bad.hlo:5"
+
+
+def test_anchor_commandlist_line(tmp_path):
+    diags = analyze_trace_dir(
+        make_trace(tmp_path, commands=[
+            {"kind": "kernel_launch", "module": "good", "device": 0},
+            {"kind": "kernel_launch", "module": "nope", "device": 0},
+        ]),
+        arch="v5e", tuned=False,
+    )
+    (d,) = diags.by_code("TL006")
+    assert d.anchor == "commandlist.jsonl:2"
+
+
+def test_line_walk_parity_with_reference_parser():
+    """The analyzer's line-anchored module walk must stay behaviorally
+    identical to hlo_text.parse_hlo_module — if the two parsers drift,
+    lint and replay stop agreeing on what a trace contains.  Pinned on
+    the real multi-computation golden fixture."""
+    from tpusim.analysis.trace_passes import _parse_module_lines
+    from tpusim.trace.hlo_text import parse_hlo_module
+
+    path = (
+        Path(__file__).parent / "fixtures" / "traces"
+        / "llama_tiny_tp2dp2" / "modules" / "llama_tiny_tp2dp2.hlo"
+    )
+    text = path.read_text()
+    ref = parse_hlo_module(text, name_hint="llama_tiny_tp2dp2")
+    pm = _parse_module_lines("llama_tiny_tp2dp2", "m.hlo", text)
+    got = pm.module
+    assert got.name == ref.name
+    assert got.entry_name == ref.entry_name
+    assert got.meta.get("num_partitions") == ref.meta.get(
+        "num_partitions"
+    )
+    assert sorted(got.computations) == sorted(ref.computations)
+    for name, comp in ref.computations.items():
+        got_ops = [(o.name, o.opcode) for o in got.computations[name].ops]
+        ref_ops = [(o.name, o.opcode) for o in comp.ops]
+        assert got_ops == ref_ops, f"drift in computation {name}"
+        # every op has a line anchor, and anchors are strictly ordered
+        lines = [
+            pm.op_lines[(name, o.name)]
+            for o in got.computations[name].ops
+        ]
+        assert lines == sorted(lines)
+    assert not pm.skipped
+
+
+def test_roofline_pass_survives_non_numeric_overlay(tmp_path):
+    """A stringly-typed overlay value must yield diagnostics, not a
+    TypeError traceback (the analyzer exists to report broken configs,
+    not crash on them)."""
+    diags = analyze_trace_dir(
+        make_trace(tmp_path), arch="v5e",
+        overlays=[{"arch": {"vpu_lanes": "128", "mxu_rows": "8"}}],
+        tuned=False,
+    )
+    assert {"TL101"} <= diags.codes()
+
+
+def test_clean_trace_is_clean(tmp_path):
+    diags = analyze_trace_dir(
+        make_trace(tmp_path), arch="v5e", tuned=False,
+    )
+    assert diags.items == [], "\n".join(diags.text_lines())
+
+
+def test_golden_fixtures_lint_clean():
+    """The acceptance gate in miniature: every checked-in fixture trace
+    lints with zero error-level diagnostics on every matrix arch."""
+    fixtures = Path(__file__).parent / "fixtures" / "traces"
+    for fixture in ("matmul_512", "llama_tiny_tp2dp2"):
+        for arch in ("v5e", "v5p", "v6e"):
+            diags = analyze_trace_dir(
+                fixtures / fixture, arch=arch, tuned=False,
+            )
+            assert not diags.has_errors, (
+                f"{fixture}@{arch}:\n" + "\n".join(diags.text_lines())
+            )
+
+
+def test_repo_statskey_audit_clean():
+    diags = analyze_stats_keys()
+    assert not diags.items, "\n".join(diags.text_lines())
+
+
+# ---------------------------------------------------------------------------
+# JSON round-trip + registry listing
+# ---------------------------------------------------------------------------
+
+
+def test_json_roundtrip(tmp_path):
+    diags = analyze_trace_dir(
+        make_trace(tmp_path, commands=[
+            {"kind": "kernel_launch", "module": "nope", "device": 9},
+        ]),
+        arch="v5e", tuned=False,
+    )
+    assert diags.items
+    doc = json.loads(diags.to_json())
+    assert doc["format_version"] == 1
+    assert doc["counts"]["error"] == diags.count(Severity.ERROR)
+    back = Diagnostics.from_doc(doc)
+    assert {(d.code, d.severity, d.message, d.file, d.line)
+            for d in back.items} \
+        == {(d.code, d.severity, d.message, d.file, d.line)
+            for d in diags.items}
+
+
+def test_list_codes_matches_registry():
+    lines = list_code_lines()
+    assert len(lines) == len(CODES)
+    for line in lines:
+        code, severity = line.split()[:2]
+        assert CODES[code].severity.value == severity
+        assert CODES[code].summary in line
+
+
+# ---------------------------------------------------------------------------
+# CLI + --validate integration
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_exit_codes(tmp_path, capsys):
+    from tpusim.__main__ import main
+
+    good = make_trace(tmp_path)
+    assert main(["lint", str(good), "--arch", "v5e"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out
+
+    bad = tmp_path / "bad"
+    (bad / "modules").mkdir(parents=True)
+    (bad / "modules" / "m.hlo").write_text(GOOD_HLO)
+    (bad / "commandlist.jsonl").write_text(
+        json.dumps({"kind": "kernel_launch", "module": "zzz"}) + "\n"
+    )
+    assert main(["lint", str(bad), "--arch", "v5e"]) == 1
+    out = capsys.readouterr().out
+    assert "TL006" in out
+
+
+def test_cli_lint_strict_gates_warnings(tmp_path, capsys):
+    from tpusim.__main__ import main
+
+    # zero-byte standalone collective: warning-only trace
+    trace = make_trace(tmp_path, commands=[
+        {"kind": "kernel_launch", "module": "good", "device": 0},
+        {"kind": "collective", "device": 0, "bytes": 0,
+         "collective": {"kind": "all-reduce",
+                        "replica_groups": [[0, 1], [2, 3]]}},
+    ])
+    assert main(["lint", str(trace), "--arch", "v5e"]) == 0
+    capsys.readouterr()
+    assert main(
+        ["lint", str(trace), "--arch", "v5e", "--strict"]
+    ) == 1
+    assert "TL015" in capsys.readouterr().out
+
+
+def test_cli_lint_json_format(tmp_path, capsys):
+    from tpusim.__main__ import main
+
+    assert main([
+        "lint", str(make_trace(tmp_path)), "--arch", "v5e",
+        "--format", "json",
+    ]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["diagnostics"] == []
+
+
+def test_cli_list_codes(capsys):
+    from tpusim.__main__ import main
+
+    assert main(["lint", "--list-codes"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == list_code_lines()
+
+
+def test_validate_refuses_broken_trace(tmp_path):
+    from tpusim.analysis import ValidationError
+    from tpusim.sim.driver import simulate_trace
+
+    trace = make_trace(tmp_path, commands=[
+        {"kind": "kernel_launch", "module": "nope", "device": 0},
+        {"kind": "kernel_launch", "module": "good", "device": 0},
+    ])
+    with pytest.raises(ValidationError) as ei:
+        simulate_trace(trace, arch="v5e", tuned=False, validate="on")
+    assert "TL006" in str(ei.value)
+    # the same trace still prices without --validate (opt-in contract):
+    # the driver only needs the launches it can resolve... it cannot —
+    # an unknown module raises at replay time; validate just says so
+    # up front with an anchor instead of mid-run
+    with pytest.raises(KeyError):
+        simulate_trace(trace, arch="v5e", tuned=False)
+
+
+def test_validate_strict_gates_warnings(tmp_path):
+    from tpusim.analysis import ValidationError
+    from tpusim.sim.driver import simulate_trace
+
+    trace = make_trace(tmp_path, commands=[
+        {"kind": "kernel_launch", "module": "good", "device": 0},
+        {"kind": "collective", "device": 0, "bytes": 0,
+         "collective": {"kind": "all-reduce",
+                        "replica_groups": [[0, 1], [2, 3]]}},
+    ])
+    report = simulate_trace(
+        trace, arch="v5e", tuned=False, validate="on",
+    )
+    assert report.cycles > 0
+    with pytest.raises(ValidationError):
+        simulate_trace(
+            trace, arch="v5e", tuned=False, validate="strict",
+        )
+
+
+def test_validate_clean_trace_passes(tmp_path):
+    from tpusim.sim.driver import simulate_trace
+
+    report = simulate_trace(
+        make_trace(tmp_path), arch="v5e", tuned=False, validate="on",
+    )
+    assert report.cycles > 0
+
+
+def test_validate_analyzes_explicit_config(tmp_path):
+    """A config passed explicitly to simulate_trace is the one that
+    replays, so it is the one --validate must analyze."""
+    import dataclasses
+
+    from tpusim.analysis import ValidationError
+    from tpusim.sim.driver import simulate_trace
+    from tpusim.timing.config import SimConfig
+
+    broken = dataclasses.replace(
+        SimConfig(),
+        arch=dataclasses.replace(SimConfig().arch, clock_ghz=0.0),
+    )
+    trace = make_trace(tmp_path)
+    with pytest.raises(ValidationError) as ei:
+        simulate_trace(trace, config=broken, validate="on")
+    assert "TL101" in str(ei.value)
+
+
+def test_undeclared_pod_allows_any_device_lane(tmp_path):
+    """Without an explicit meta num_devices, the driver infers the pod
+    from the command lanes — lint must not invent a range to enforce
+    (a 1-wide module legitimately replays on every lane)."""
+    trace = make_trace(
+        tmp_path,
+        hlo=GOOD_HLO.replace(", num_partitions=4", ""),
+        meta={"device_kind": "cpu"},
+        commands=[
+            {"kind": "kernel_launch", "module": "good", "device": 0},
+            {"kind": "kernel_launch", "module": "good", "device": 1},
+        ],
+    )
+    diags = analyze_trace_dir(trace, arch="v5e", tuned=False)
+    assert not diags.by_code("TL007"), "\n".join(diags.text_lines())
+
+
+def test_validate_binds_schedule_to_explicit_topology(tmp_path):
+    """simulate_trace(topology=...) binds faults against that topology;
+    --validate must judge the schedule against the same one."""
+    from tpusim.sim.driver import simulate_trace
+
+    topo = torus_for(8, "v5p")  # wider than the trace's 4 lanes
+    a, b = topo.undirected_links()[-1]
+    sched = {"faults": [{"kind": "link_down", "src": a, "dst": b}]}
+    trace = make_trace(tmp_path)
+    report = simulate_trace(
+        trace, arch="v5p", tuned=False, topology=topo, faults=sched,
+        validate="on",
+    )
+    assert report.stats.get("faults_links_down") == 2
+
+
+def test_validate_escalates_parse_damage_under_strict_loader(tmp_path):
+    """A malformed HLO line is fatal to the default strict load_trace,
+    so non-lenient --validate must refuse it up front (TL012 at error
+    severity); the lenient replay keeps it a warning."""
+    from tpusim.analysis import ValidationError
+    from tpusim.sim.driver import simulate_trace
+
+    hlo = GOOD_HLO.replace(
+        "  ROOT %r = f32[8]{0} negate(%p0)\n",
+        "  %x = f32[8]{0} add(%p0, %p0 qq\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n",
+    )
+    trace = make_trace(tmp_path, hlo=hlo, name="good")
+    with pytest.raises(ValidationError) as ei:
+        simulate_trace(trace, arch="v5e", tuned=False, validate="on")
+    assert "TL012" in str(ei.value)
+    report = simulate_trace(
+        trace, arch="v5e", tuned=False, validate="on", lenient=True,
+    )
+    assert report.cycles > 0
+
+
+def test_cli_lint_faults_requires_trace(capsys):
+    from tpusim.__main__ import main
+
+    assert main(["lint", "--stats-keys", "--faults", "x.json"]) == 2
+    assert "need a trace dir" in capsys.readouterr().err
+
+
+def test_overlap_directed_vs_undirected_same_cable():
+    """A directed fault written src>dst still stacks with an undirected
+    fault on the same cable (normalized-cable bucketing)."""
+    topo = torus_for(8, "v5p")
+    diags = analyze_schedule({"faults": [
+        {"kind": "link_degraded", "src": 1, "dst": 0,
+         "bandwidth_scale": 0.5, "directed": True},
+        {"kind": "link_down", "src": 0, "dst": 1},
+    ]}, topo)
+    assert diags.by_code("TL203"), "\n".join(diags.text_lines())
+    # ... but opposite DIRECTED halves of one cable are two physical
+    # links: no stacking, no diagnostic
+    diags = analyze_schedule({"faults": [
+        {"kind": "link_degraded", "src": 1, "dst": 0,
+         "bandwidth_scale": 0.5, "directed": True},
+        {"kind": "link_degraded", "src": 0, "dst": 1,
+         "bandwidth_scale": 0.5, "directed": True},
+    ]}, topo)
+    assert not diags.by_code("TL203"), "\n".join(diags.text_lines())
+
+
+# ---------------------------------------------------------------------------
+# Lenient-parse dedup satellite (hlo_text)
+# ---------------------------------------------------------------------------
+
+
+def test_lenient_parse_dedups_repeated_malformed_lines():
+    from tpusim.trace.hlo_text import parse_hlo_module
+
+    corrupt = "  %x = f32[8]{0} add(%p0, %p0 qq\n"
+    text = (
+        "HloModule torn\n"
+        "\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        + corrupt * 50
+        + "  %y = f32[8]{0} oops(%p0 zz\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n"
+        "}\n"
+    )
+    with pytest.warns(UserWarning, match="2 distinct"):
+        mod = parse_hlo_module(text, name_hint="torn", strict=False)
+    assert mod.meta["parse_skipped_lines"] == 51
+    assert mod.meta["parse_skipped_distinct"] == 2
+    assert len(mod.meta["parse_skipped_samples"]) == 2
+
+
+def test_lint_surfaces_parse_damage_as_tl012(tmp_path):
+    hlo = (
+        "HloModule torn, num_partitions=4\n"
+        "\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  %x = f32[8]{0} add(%p0, %p0 qq\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n"
+        "}\n"
+    )
+    diags = analyze_trace_dir(
+        make_trace(tmp_path, hlo=hlo, name="torn"),
+        arch="v5e", tuned=False,
+    )
+    (d,) = diags.by_code("TL012")
+    assert d.severity is Severity.WARNING
+    assert d.anchor == "modules/torn.hlo:5"

@@ -6,7 +6,6 @@ subprocess CPU mesh (see conftest) — the "fake cluster" this framework uses
 the way the reference uses procman + prerecorded traces (SURVEY.md §4).
 """
 
-import json
 import sys
 
 import pytest

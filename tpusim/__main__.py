@@ -51,6 +51,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     report = simulate_trace(
         args.trace, arch=args.arch, overlays=overlays, obs=obs,
         faults=faults, lenient=args.lenient_parse,
+        validate=args.validate,
     )
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -368,6 +369,50 @@ def _cmd_faults(args: argparse.Namespace) -> int:
             json.dump(result.to_doc(), f, indent=2)
         print(f"  sweep report written to {args.json}")
     return 0
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """Static trace/config/schedule analyzer — the `tpusim lint` front
+    end over :mod:`tpusim.analysis` (stable TLxxx codes, file:line
+    anchors, text or JSON output, nonzero exit on errors)."""
+    from tpusim.analysis import (
+        Severity, analyze_stats_keys, analyze_trace_dir, list_code_lines,
+    )
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    if args.list_codes:
+        for line in list_code_lines():
+            print(line)
+        return 0
+    if args.trace is None and not args.stats_keys:
+        print("tpusim lint: nothing to analyze — pass a trace dir, "
+              "--stats-keys, or --list-codes", file=sys.stderr)
+        return 2
+    if args.trace is None and (args.faults or args.config or args.arch):
+        print("tpusim lint: --faults/--config/--arch need a trace dir "
+              "(the declared topology and capture meta come from it)",
+              file=sys.stderr)
+        return 2
+
+    diags = Diagnostics()
+    if args.trace is not None:
+        analyze_trace_dir(
+            args.trace, arch=args.arch, overlays=list(args.config or []),
+            faults=args.faults, diags=diags,
+        )
+    if args.stats_keys:
+        analyze_stats_keys(diags=diags)
+
+    if args.format == "json":
+        print(diags.to_json())
+    else:
+        for line in diags.text_lines():
+            print(line)
+        print(f"tpusim lint: {diags.summary()}")
+    gate = diags.has_errors or (
+        args.strict and diags.count(Severity.WARNING) > 0
+    )
+    return 1 if gate else 0
 
 
 def _cmd_info(args: argparse.Namespace) -> int:
@@ -691,6 +736,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="skip malformed HLO lines with a counted "
                          "warning instead of raising mid-file (salvage "
                          "mode for damaged captures)")
+    ps.add_argument("--validate", nargs="?", const="on", default=None,
+                    choices=["on", "strict"], metavar="on|strict",
+                    help="pre-flight the trace/config/schedule through "
+                         "the static analyzer (tpusim lint) and refuse "
+                         "to replay on error-level diagnostics; "
+                         "--validate=strict also refuses on warnings. "
+                         "NOTE: bare --validate greedily binds a "
+                         "following positional, so place it AFTER the "
+                         "trace path or use the = form")
     ps.set_defaults(fn=_cmd_simulate)
 
     pc = sub.add_parser("capture", help="capture a registered workload")
@@ -819,6 +873,37 @@ def main(argv: list[str] | None = None) -> int:
     pfa.add_argument("--json", default=None,
                      help="write the full sweep report here")
     pfa.set_defaults(fn=_cmd_faults)
+
+    pli = sub.add_parser(
+        "lint",
+        help="static trace/config/schedule analyzer: TLxxx diagnostics "
+             "with file:line anchors, before anything is priced",
+    )
+    pli.add_argument("trace", nargs="?", default=None,
+                     help="trace directory to analyze")
+    pli.add_argument("--arch", default=None,
+                     help="config preset to cross-check (default: the "
+                          "arch the trace was captured on)")
+    pli.add_argument("--config", action="append",
+                     help="overlay flag file(s), applied like simulate's")
+    pli.add_argument("--faults", default=None, metavar="SCHEDULE.json",
+                     help="fault schedule to validate against the "
+                          "trace's declared topology")
+    pli.add_argument("--format", choices=["text", "json"],
+                     default="text",
+                     help="diagnostic output format (json is the "
+                          "machine-readable document)")
+    pli.add_argument("--strict", action="store_true",
+                     help="exit nonzero on warnings too, not just "
+                          "errors")
+    pli.add_argument("--stats-keys", action="store_true",
+                     help="also audit the repo's obs_/faults_/ici_ "
+                          "stats-key namespaces (ownership, collisions, "
+                          "schema agreement)")
+    pli.add_argument("--list-codes", action="store_true",
+                     help="print the diagnostic registry (code, "
+                          "severity, one-liner) and exit")
+    pli.set_defaults(fn=_cmd_lint)
 
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")

@@ -1,0 +1,42 @@
+"""tpusim.analysis — static trace/config/schedule analyzer.
+
+Multi-pass static analysis with a shared diagnostics core: stable codes
+(``TL001``...), error/warning/info severities, ``file:line`` anchors
+into ``commandlist.jsonl`` / ``.hlo`` modules / schedule files, and a
+machine-readable JSON form.  Three pass families (trace, config,
+schedule) plus a repo-level stats-key contract audit.  Reached three
+ways: the ``tpusim lint`` CLI, the opt-in ``simulate --validate``
+pre-flight, and ``ci/check_golden.py --lint-smoke``.
+"""
+
+from tpusim.analysis.diagnostics import (
+    CODES,
+    CodeInfo,
+    Diagnostic,
+    Diagnostics,
+    Severity,
+    list_code_lines,
+)
+from tpusim.analysis.runner import (
+    ValidationError,
+    analyze_config,
+    analyze_schedule,
+    analyze_stats_keys,
+    analyze_trace_dir,
+)
+from tpusim.analysis.statskeys import STATS_NAMESPACES
+
+__all__ = [
+    "CODES",
+    "CodeInfo",
+    "Diagnostic",
+    "Diagnostics",
+    "Severity",
+    "STATS_NAMESPACES",
+    "ValidationError",
+    "analyze_config",
+    "analyze_schedule",
+    "analyze_stats_keys",
+    "analyze_trace_dir",
+    "list_code_lines",
+]

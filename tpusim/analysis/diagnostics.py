@@ -1,0 +1,254 @@
+"""Shared diagnostics core for the static analyzer (``tpusim lint``).
+
+Every check in :mod:`tpusim.analysis` reports through this module: a
+stable diagnostic **code** (``TL001`` — never renumbered, so CI greps
+and suppressions survive refactors), a **severity** (error / warning /
+info), an optional ``file:line`` **anchor** into the artifact that
+triggered it (``commandlist.jsonl`` line, ``.hlo`` module line, config
+or schedule file), and a machine-readable JSON form.
+
+The code registry below is the single source of truth: ``tpusim lint
+--list-codes`` prints it, ``docs/ARCHITECTURE.md`` carries a copy of
+the table, and the seeded-defect corpus in ``tests/test_lint.py``
+asserts every code can actually fire.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+
+__all__ = [
+    "CODES",
+    "CodeInfo",
+    "Diagnostic",
+    "Diagnostics",
+    "Severity",
+    "list_code_lines",
+]
+
+JSON_FORMAT_VERSION = 1
+
+
+class Severity(enum.Enum):
+    """Diagnostic severity — errors gate (nonzero exit / ``--validate``
+    refusal), warnings inform, info narrates."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    @property
+    def rank(self) -> int:
+        return {"error": 2, "warning": 1, "info": 0}[self.value]
+
+
+@dataclass(frozen=True)
+class CodeInfo:
+    """One registry entry: stable code, default severity, one-liner."""
+
+    code: str
+    severity: Severity
+    summary: str
+
+
+CODES: dict[str, CodeInfo] = {}
+
+
+def _code(code: str, severity: Severity, summary: str) -> None:
+    if code in CODES:
+        raise ValueError(f"duplicate diagnostic code {code}")
+    CODES[code] = CodeInfo(code, severity, summary)
+
+
+_E, _W, _I = Severity.ERROR, Severity.WARNING, Severity.INFO
+
+# --- trace passes (TL0xx) --------------------------------------------------
+_code("TL001", _E, "operand references a value never defined in its "
+                   "computation")
+_code("TL002", _E, "operand used before its definition in the schedule "
+                   "order")
+_code("TL003", _W, "operand count outside the opcode's known arity")
+_code("TL004", _E, "elementwise operand/result shape or dtype mismatch")
+_code("TL005", _E, "while body/condition parameter or result shape "
+                   "disagreement")
+_code("TL006", _E, "kernel_launch references a module the trace does not "
+                   "carry")
+_code("TL007", _E, "command device id outside the declared pod")
+_code("TL008", _E, "collective result bytes inconsistent with operand "
+                   "shapes and group size")
+_code("TL009", _E, "replica group member out of range or duplicated")
+_code("TL010", _E, "malformed trace artifact line (commandlist/meta JSON)")
+_code("TL011", _E, "module has no ENTRY computation")
+_code("TL012", _W, "parse skipped malformed HLO lines (salvage-mode "
+                   "damage)")
+_code("TL013", _E, "op calls a computation the module does not contain")
+_code("TL014", _W, "replica groups do not tile the pod exactly")
+_code("TL015", _W, "standalone collective command with zero byte count")
+
+# --- config passes (TL1xx) -------------------------------------------------
+_code("TL101", _E, "config field must be positive (clock/bandwidth/"
+                   "dimension)")
+_code("TL102", _W, "derived roofline number outside plausible bounds")
+_code("TL103", _W, "trace device kind maps to a different arch than the "
+                   "chosen config")
+_code("TL104", _E, "efficiency/fraction config field outside (0, 1]")
+_code("TL105", _E, "unknown enum value (topology/network_mode)")
+_code("TL106", _E, "config field must be non-negative (latency/cycle "
+                   "count)")
+_code("TL107", _E, "config does not compose (unknown preset, missing "
+                   "or unparseable overlay)")
+
+# --- schedule passes (TL2xx) -----------------------------------------------
+_code("TL201", _E, "fault schedule fails format/window validation")
+_code("TL202", _E, "fault endpoint/link does not exist on the declared "
+                   "torus")
+_code("TL203", _W, "overlapping faults target the same link or chip")
+_code("TL204", _I, "fault with scale 1.0 has no effect")
+
+# --- stats-key contract (TL3xx) --------------------------------------------
+_code("TL301", _E, "stats key written outside its namespace's owning "
+                   "subsystem")
+_code("TL302", _W, "stats prefix not in the documented namespace registry")
+_code("TL303", _E, "schema-required stats key not found in audited "
+                   "sources")
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: code + severity + message + optional artifact anchor."""
+
+    code: str
+    severity: Severity
+    message: str
+    file: str | None = None
+    line: int | None = None
+
+    @property
+    def anchor(self) -> str:
+        if self.file is None:
+            return "<repo>"
+        if self.line is None:
+            return self.file
+        return f"{self.file}:{self.line}"
+
+    def text(self) -> str:
+        return (
+            f"{self.anchor}: {self.severity.value} {self.code}: "
+            f"{self.message}"
+        )
+
+    def to_doc(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity.value,
+            "message": self.message,
+            "file": self.file,
+            "line": self.line,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Diagnostic":
+        return cls(
+            code=doc["code"],
+            severity=Severity(doc["severity"]),
+            message=doc["message"],
+            file=doc.get("file"),
+            line=doc.get("line"),
+        )
+
+
+@dataclass
+class Diagnostics:
+    """Collector shared by all passes of one ``tpusim lint`` run."""
+
+    items: list[Diagnostic] = field(default_factory=list)
+
+    def emit(
+        self,
+        code: str,
+        message: str,
+        file: str | None = None,
+        line: int | None = None,
+        severity: Severity | None = None,
+    ) -> Diagnostic:
+        info = CODES.get(code)
+        if info is None:
+            raise KeyError(f"unregistered diagnostic code {code!r}")
+        d = Diagnostic(
+            code=code,
+            severity=severity or info.severity,
+            message=message,
+            file=file,
+            line=line,
+        )
+        self.items.append(d)
+        return d
+
+    # -- queries -----------------------------------------------------------
+
+    def count(self, severity: Severity) -> int:
+        return sum(1 for d in self.items if d.severity is severity)
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.items if d.severity is Severity.ERROR]
+
+    @property
+    def has_errors(self) -> bool:
+        return any(d.severity is Severity.ERROR for d in self.items)
+
+    def codes(self) -> set[str]:
+        return {d.code for d in self.items}
+
+    def by_code(self, code: str) -> list[Diagnostic]:
+        return [d for d in self.items if d.code == code]
+
+    # -- output ------------------------------------------------------------
+
+    def sorted_items(self) -> list[Diagnostic]:
+        """Stable presentation order: severity first, then anchor."""
+        return sorted(
+            self.items,
+            key=lambda d: (
+                -d.severity.rank, d.file or "", d.line or 0, d.code,
+            ),
+        )
+
+    def summary(self) -> str:
+        return (
+            f"{self.count(Severity.ERROR)} error(s), "
+            f"{self.count(Severity.WARNING)} warning(s), "
+            f"{self.count(Severity.INFO)} info"
+        )
+
+    def text_lines(self) -> list[str]:
+        return [d.text() for d in self.sorted_items()]
+
+    def to_doc(self) -> dict:
+        return {
+            "format_version": JSON_FORMAT_VERSION,
+            "diagnostics": [d.to_doc() for d in self.sorted_items()],
+            "counts": {
+                s.value: self.count(s) for s in Severity
+            },
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_doc(), indent=2)
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Diagnostics":
+        return cls(
+            items=[Diagnostic.from_doc(d) for d in doc["diagnostics"]]
+        )
+
+
+def list_code_lines() -> list[str]:
+    """The ``--list-codes`` table: one ``CODE severity summary`` line per
+    registered code, in code order (docs/CI cross-check this output)."""
+    return [
+        f"{c.code}  {c.severity.value:7s}  {c.summary}"
+        for c in sorted(CODES.values(), key=lambda c: c.code)
+    ]

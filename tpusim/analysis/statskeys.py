@@ -1,0 +1,196 @@
+"""Stats-key contract pass: static audit of the report-key namespaces.
+
+The greppable ``tpusim_*`` report is a public contract — scrapers,
+goldens, and the obs/faults schemas all key on it.  PR 1 and PR 2 each
+reserved a namespace (``obs_*``, ``faults_*``) with a no-op-default
+discipline; ``ici_*`` names the shared interconnect field/track family.
+Nothing enforced any of that until now.  This pass scans the *source*
+of the subsystems that stamp stats (string literals + ``prefix=``
+kwargs, via a token-level scan — no imports, so a broken module still
+lints) and checks:
+
+* **ownership** (TL301) — a key in a reserved namespace may only be
+  introduced by the subsystem that owns it (the driver, which assembles
+  the report, is a licensed writer for all of them);
+* **documented prefixes** (TL302) — every ``update(..., prefix=...)``
+  namespace injection must use a prefix from the registry below;
+* **schema agreement** (TL303) — every key ``ci/faults_schema.json``
+  requires when a schedule is active must actually be produced
+  somewhere in the audited sources.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+from tpusim.analysis.diagnostics import Diagnostics
+
+__all__ = ["STATS_NAMESPACES", "run_statskey_passes"]
+
+#: namespace prefix -> repo-relative paths (files or directory prefixes)
+#: licensed to introduce keys in it.  The driver and CLI assemble the
+#: final report, so they may stamp any namespace; schemas document them.
+STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
+    "obs_": (
+        "tpusim/obs/", "tpusim/sim/driver.py", "tpusim/sim/stats.py",
+        "tpusim/__main__.py",
+    ),
+    "faults_": (
+        "tpusim/faults/", "tpusim/sim/driver.py",
+        "ci/faults_schema.json", "ci/check_golden.py",
+    ),
+    # the interconnect field family is shared by design: the engine
+    # accumulates ici_bytes, the sampler carries the lane, the exports
+    # derive ici_occupancy/ici_gbps tracks
+    "ici_": (
+        "tpusim/ici/", "tpusim/obs/", "tpusim/timing/engine.py",
+        "tpusim/sim/driver.py",
+    ),
+}
+
+#: keys deliberately shared across surfaces, with the subsystems licensed
+#: to carry them.  ``faults_active`` is PR 2's designed bridge: the
+#: faults package stamps it as a report key AND the obs export derives
+#: the same-named samples column / Perfetto counter track from the
+#: "faults" lane — one name, one meaning, two surfaces.
+SHARED_KEYS: dict[str, tuple[str, ...]] = {
+    "faults_active": ("tpusim/faults", "tpusim/obs", "tpusim/sim"),
+}
+
+#: prefixes `StatsRegistry.update(..., prefix=...)` may inject; "" is the
+#: merge-in-place form, "tot_" the engine-totals block
+DOCUMENTED_UPDATE_PREFIXES = frozenset(
+    set(STATS_NAMESPACES) | {"", "tot_"}
+)
+
+#: the source files whose stats-key surface is audited
+AUDIT_GLOBS = (
+    "tpusim/sim/stats.py",
+    "tpusim/sim/driver.py",
+    "tpusim/__main__.py",
+    "tpusim/obs/*.py",
+    "tpusim/faults/*.py",
+    "tpusim/ici/*.py",
+    "tpusim/timing/engine.py",
+)
+
+_KEY_RE = re.compile(
+    r"""["']((?:obs|faults|ici)_[a-z0-9_.]+)["']"""
+)
+_PREFIX_KWARG_RE = re.compile(
+    r"""prefix\s*=\s*["']([a-z0-9_.]*)["']"""
+)
+
+
+def _audit_files(root: Path) -> list[Path]:
+    out: list[Path] = []
+    for pat in AUDIT_GLOBS:
+        out.extend(sorted(root.glob(pat)))
+    return out
+
+
+def _subsystem(rel: str) -> str:
+    """Grouping key for collision reporting: the owning package dir."""
+    parts = rel.split("/")
+    return "/".join(parts[:2]) if len(parts) > 2 else rel
+
+
+def _owner_allows(owners: tuple[str, ...], rel: str) -> bool:
+    return any(
+        rel == o or (o.endswith("/") and rel.startswith(o))
+        for o in owners
+    )
+
+
+def run_statskey_passes(
+    diags: Diagnostics,
+    root: str | Path | None = None,
+    schema_path: str | Path | None = None,
+) -> None:
+    """Audit the stats-key namespaces of the repo at ``root`` (defaults
+    to the repo this module lives in; ``schema_path`` defaults to its
+    ``ci/faults_schema.json``)."""
+    root = Path(root) if root is not None else \
+        Path(__file__).resolve().parents[2]
+    found: dict[str, set[str]] = {}   # key -> set of rel paths
+    for path in _audit_files(root):
+        rel = path.relative_to(root).as_posix()
+        text = path.read_text()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            code = line.split("#", 1)[0]
+            for m in _KEY_RE.finditer(code):
+                key = m.group(1)
+                found.setdefault(key, set()).add(rel)
+                prefix = next(
+                    p for p in STATS_NAMESPACES if key.startswith(p)
+                )
+                if key in SHARED_KEYS:
+                    if _subsystem(rel) not in SHARED_KEYS[key]:
+                        diags.emit(
+                            "TL301",
+                            f"shared stats key {key!r} carried outside "
+                            f"its licensed subsystems "
+                            f"{list(SHARED_KEYS[key])}",
+                            file=rel, line=lineno,
+                        )
+                elif not _owner_allows(STATS_NAMESPACES[prefix], rel):
+                    diags.emit(
+                        "TL301",
+                        f"stats key {key!r} introduced outside the "
+                        f"{prefix}* namespace owners "
+                        f"{list(STATS_NAMESPACES[prefix])}",
+                        file=rel, line=lineno,
+                    )
+            for m in _PREFIX_KWARG_RE.finditer(code):
+                prefix = m.group(1)
+                if prefix not in DOCUMENTED_UPDATE_PREFIXES:
+                    diags.emit(
+                        "TL302",
+                        f"stats prefix {prefix!r} is not in the "
+                        f"documented namespace registry "
+                        f"({sorted(DOCUMENTED_UPDATE_PREFIXES - {''})})"
+                        f" — register it in tpusim.analysis.statskeys "
+                        f"or reuse an existing namespace",
+                        file=rel, line=lineno,
+                    )
+
+    # cross-subsystem collision: the same reserved key minted by two
+    # different packages means two writers race for one report line
+    for key, rels in sorted(found.items()):
+        if not key.startswith(("obs_", "faults_")):
+            continue  # ici_* is a shared field family by design
+        subsystems = {
+            _subsystem(r) for r in rels if not r.startswith("ci/")
+        }
+        subsystems -= set(SHARED_KEYS.get(key, ()))
+        if len(subsystems) > 1:
+            diags.emit(
+                "TL301",
+                f"stats key {key!r} is minted by multiple subsystems "
+                f"({sorted(subsystems)}) — one writer must own each "
+                f"report line",
+            )
+
+    schema_path = Path(schema_path) if schema_path is not None else \
+        root / "ci" / "faults_schema.json"
+    if schema_path.exists():
+        try:
+            schema = json.loads(schema_path.read_text())
+        except json.JSONDecodeError as e:
+            diags.emit(
+                "TL303",
+                f"cannot audit stats schema: invalid JSON: {e}",
+                file=schema_path.name,
+            )
+            return
+        for key in schema.get("stats_required_when_active", []):
+            if key not in found:
+                diags.emit(
+                    "TL303",
+                    f"schema requires stats key {key!r} when a fault "
+                    f"schedule is active, but no audited source "
+                    f"produces it",
+                    file=schema_path.name,
+                )

@@ -1,0 +1,624 @@
+"""Trace passes: static checks over a trace directory, pre cycle 0.
+
+The Accel-Sim pipeline silently trusts its trace directories — a
+malformed ``kernelslist.g`` entry or a config/trace mismatch surfaces as
+a crash (or a wrong number) deep inside the cycle loop.  These passes
+verify the cross-artifact contracts a tpusim trace dir carries
+(``meta.json`` ↔ ``modules/*.hlo`` ↔ ``commandlist.jsonl``) *before*
+anything is priced:
+
+* **HLO dataflow** — def-before-use and schedule-order use (TL001/002),
+  opcode arity (TL003), elementwise shape/dtype agreement (TL004),
+  while body/condition shape contracts (TL005), called-computation
+  referential integrity (TL013), ENTRY presence (TL011);
+* **collective semantics** — result bytes vs operand shapes and group
+  size (TL008), replica-group range/duplication (TL009) and pod tiling
+  (TL014);
+* **commandlist referential integrity** — JSONL syntax (TL010), module
+  references (TL006), device-id range (TL007), zero-byte standalone
+  collectives (TL015);
+* **salvage damage** — malformed lines a lenient parse would skip
+  (TL012).
+
+Anchors: every module diagnostic carries ``modules/<name>.hlo:<line>``
+and every command diagnostic ``commandlist.jsonl:<line>``, so findings
+are jump-to-able from an editor or CI log.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpusim.analysis.diagnostics import Diagnostics
+from tpusim.ir import (
+    COLLECTIVE_OPCODES,
+    Computation,
+    ModuleTrace,
+    TensorSpec,
+    TraceOp,
+    TupleSpec,
+    base_opcode,
+)
+from tpusim.trace.hlo_text import (
+    _COMP_HEADER_RE,
+    _MODULE_RE,
+    parse_instruction,
+    parse_module_attrs,
+)
+
+__all__ = ["ParsedTrace", "load_parsed_trace", "run_trace_passes"]
+
+
+# ---------------------------------------------------------------------------
+# Line-anchored module parse (mirrors hlo_text.parse_hlo_module, but keeps
+# the line number of every op — the parser discards it, the linter is
+# *about* it)
+# ---------------------------------------------------------------------------
+
+
+_AUX_SECTIONS = (
+    "FileNames", "FunctionNames", "FileLocations", "StackFrames",
+)
+
+
+@dataclass
+class ParsedModule:
+    """One module plus the artifact anchors the passes report against."""
+
+    key: str                     # trace key (file stem)
+    file: str                    # anchor path, e.g. "modules/foo.hlo"
+    module: ModuleTrace = field(default_factory=lambda: ModuleTrace(""))
+    #: (computation name, op name) -> 1-based line number
+    op_lines: dict[tuple[str, str], int] = field(default_factory=dict)
+    #: computation name -> header line number
+    comp_lines: dict[str, int] = field(default_factory=dict)
+    #: malformed lines a lenient parse would skip: (lineno, error)
+    skipped: list[tuple[int, str]] = field(default_factory=list)
+
+
+@dataclass
+class ParsedTrace:
+    """A trace dir loaded for analysis: modules with line maps, raw
+    command records with line numbers, and the declared pod size."""
+
+    path: Path
+    meta: dict = field(default_factory=dict)
+    meta_error: str | None = None
+    modules: dict[str, ParsedModule] = field(default_factory=dict)
+    #: (lineno, record | None, error | None) from commandlist.jsonl
+    commands: list[tuple[int, dict | None, str | None]] = field(
+        default_factory=list
+    )
+    has_commandlist: bool = False
+
+    @property
+    def meta_devices(self) -> int | None:
+        """Pod size ``meta.json`` EXPLICITLY declares, or None.  Only
+        this gates the device-id/group range checks: a module's
+        replica*partition product is not a pod declaration (a 1-wide
+        module legitimately replays on every lane of a wider pod)."""
+        try:
+            n = int(self.meta.get("num_devices", 0) or 0)
+        except (TypeError, ValueError):
+            return None
+        return n if n > 0 else None
+
+    @property
+    def replay_devices(self) -> int:
+        """The pod size the driver would actually replay with — mirrors
+        ``SimDriver.run``'s ``n_devices`` (max of the meta declaration,
+        the widest module, and the command-stream lane count), so the
+        schedule passes bind faults against the same topology the
+        replay builds."""
+        lanes = {
+            rec.get("device", 0)
+            for _, rec, err in self.commands
+            if err is None and isinstance(rec.get("device", 0), int)
+        }
+        return max(
+            self.meta_devices or 0,
+            max(
+                (pm.module.num_devices for pm in self.modules.values()),
+                default=1,
+            ),
+            len(lanes) or 1,
+            1,
+        )
+
+
+def _parse_module_lines(key: str, file: str, text: str) -> ParsedModule:
+    pm = ParsedModule(key=key, file=file)
+    module = pm.module
+    module.name = key
+    current: Computation | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        stripped = raw.strip()
+        if not stripped:
+            continue
+        if current is None and (
+            stripped in _AUX_SECTIONS or stripped[0].isdigit()
+        ):
+            continue
+        mm = _MODULE_RE.match(stripped)
+        if mm and current is None:
+            module.name = mm.group("name")
+            parse_module_attrs(mm.group("attrs") or "", module.meta)
+            continue
+        ch = _COMP_HEADER_RE.match(stripped)
+        if ch and current is None:
+            current = Computation(
+                name=ch.group("name"), is_entry=bool(ch.group("entry"))
+            )
+            pm.comp_lines[current.name] = lineno
+            continue
+        if current is not None:
+            if stripped == "}":
+                module.add_computation(current)
+                current = None
+                continue
+            try:
+                op = parse_instruction(stripped)
+            except ValueError as e:
+                pm.skipped.append((lineno, f"{stripped[:80]!r}: {e}"))
+                continue
+            if op is not None:
+                current.add(op)
+                pm.op_lines[(current.name, op.name)] = lineno
+    if current is not None:
+        module.add_computation(current)
+    return pm
+
+
+def load_parsed_trace(path: str | Path) -> ParsedTrace:
+    """Load a trace dir for analysis (never raises on artifact damage —
+    damage becomes diagnostics, that's the point)."""
+    from tpusim.trace.format import iter_commandlist
+
+    path = Path(path)
+    if not path.is_dir():
+        raise FileNotFoundError(f"trace directory not found: {path}")
+    pt = ParsedTrace(path=path)
+    meta_path = path / "meta.json"
+    if meta_path.exists():
+        try:
+            pt.meta = json.loads(meta_path.read_text())
+        except json.JSONDecodeError as e:
+            pt.meta_error = f"invalid JSON: {e}"
+        else:
+            if not isinstance(pt.meta, dict):
+                pt.meta_error = "meta.json is not an object"
+                pt.meta = {}
+
+    modules_dir = path / "modules"
+    if modules_dir.is_dir():
+        # parse each module as it is read — holding every module's text
+        # at once would double peak memory on multi-GB trace dirs
+        for mp in sorted(modules_dir.glob("*.hlo")):
+            pt.modules[mp.stem] = _parse_module_lines(
+                mp.stem, f"modules/{mp.name}", mp.read_text()
+            )
+        for mp in sorted(modules_dir.glob("*.hlo.gz")):
+            key = mp.name[: -len(".hlo.gz")]
+            with gzip.open(mp, "rt") as f:
+                pt.modules[key] = _parse_module_lines(
+                    key, f"modules/{mp.name}", f.read()
+                )
+
+    cl = path / "commandlist.jsonl"
+    if cl.exists():
+        pt.has_commandlist = True
+        pt.commands = list(iter_commandlist(cl))
+    return pt
+
+
+# ---------------------------------------------------------------------------
+# Shape helpers
+# ---------------------------------------------------------------------------
+
+
+def _shape_key(spec) -> object:
+    """Structural (dtype, dims) key — layouts/tilings excluded: two specs
+    with the same key hold the same logical data."""
+    if isinstance(spec, TupleSpec):
+        return tuple(_shape_key(p) for p in spec.parts)
+    return (spec.dtype, spec.shape)
+
+
+# ---------------------------------------------------------------------------
+# Opcode arity table (curated: only opcodes whose arity is fixed; variadic
+# opcodes — concatenate, fusion, reduce, dynamic-slice... — are skipped)
+# ---------------------------------------------------------------------------
+
+_UNARY = frozenset({
+    "abs", "cbrt", "ceil", "convert", "copy", "cos", "cosh", "erf", "exp",
+    "expm1", "floor", "imag", "is-finite", "log", "log1p", "logistic",
+    "negate", "not", "popcnt", "real", "round-nearest-afz",
+    "round-nearest-even", "rsqrt", "sign", "sin", "sinh", "sqrt", "tan",
+    "tanh", "bitcast", "bitcast-convert", "broadcast", "reshape",
+    "reverse", "transpose", "slice", "get-tuple-element", "while",
+    "copy-start", "copy-done", "optimization-barrier",
+})
+
+#: elementwise binaries with matching operand/result shapes AND dtypes
+_ELEMENTWISE_BINARY = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "power", "remainder", "atan2", "and", "or", "xor", "shift-left",
+    "shift-right-arithmetic", "shift-right-logical",
+})
+
+_BINARY = _ELEMENTWISE_BINARY | frozenset({"compare", "pad", "dot"})
+
+_TERNARY = frozenset({"select", "clamp"})
+
+
+def _expected_arity(base: str) -> int | None:
+    if base in _UNARY:
+        return 1
+    if base in _BINARY:
+        return 2
+    if base in _TERNARY:
+        return 3
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Passes
+# ---------------------------------------------------------------------------
+
+
+def _check_dataflow(pm: ParsedModule, diags: Diagnostics) -> None:
+    """TL001/TL002 def-before-use, TL003 arity, TL004 elementwise shape/
+    dtype consistency, TL013 called-computation integrity."""
+    module = pm.module
+    for comp in module.computations.values():
+        pos = {op.name: i for i, op in enumerate(comp.ops)}
+
+        def anchor(op: TraceOp) -> int | None:
+            return pm.op_lines.get((comp.name, op.name))
+
+        for i, op in enumerate(comp.ops):
+            for operand in op.operands:
+                if operand not in pos:
+                    diags.emit(
+                        "TL001",
+                        f"{module.name}/{comp.name}: %{op.name} reads "
+                        f"%{operand}, which is never defined in this "
+                        f"computation",
+                        file=pm.file, line=anchor(op),
+                    )
+                elif pos[operand] >= i:
+                    diags.emit(
+                        "TL002",
+                        f"{module.name}/{comp.name}: %{op.name} reads "
+                        f"%{operand} before its definition (schedule "
+                        f"position {pos[operand]} >= {i})",
+                        file=pm.file, line=anchor(op),
+                    )
+            base = op.base
+            want = _expected_arity(base)
+            if want is not None and len(op.operands) != want:
+                diags.emit(
+                    "TL003",
+                    f"{module.name}/{comp.name}: {op.opcode} "
+                    f"%{op.name} has {len(op.operands)} operand(s); "
+                    f"{base} takes exactly {want}",
+                    file=pm.file, line=anchor(op),
+                )
+            for called in op.called:
+                if called not in module.computations:
+                    diags.emit(
+                        "TL013",
+                        f"{module.name}/{comp.name}: %{op.name} calls "
+                        f"computation %{called}, which the module does "
+                        f"not contain (truncated trace?)",
+                        file=pm.file, line=anchor(op),
+                    )
+            if (
+                base in _ELEMENTWISE_BINARY
+                and len(op.operands) == 2
+                and isinstance(op.result, TensorSpec)
+            ):
+                specs = []
+                for operand in op.operands:
+                    j = pos.get(operand)
+                    if j is None or j >= i:
+                        break
+                    r = comp.ops[j].result
+                    if not isinstance(r, TensorSpec):
+                        break
+                    specs.append(r)
+                if len(specs) == 2:
+                    keys = {_shape_key(s) for s in specs}
+                    keys.add(_shape_key(op.result))
+                    if len(keys) > 1:
+                        shapes = ", ".join(str(s) for s in specs)
+                        diags.emit(
+                            "TL004",
+                            f"{module.name}/{comp.name}: {base} "
+                            f"%{op.name} -> {op.result} has "
+                            f"inconsistent operand shapes ({shapes})",
+                            file=pm.file, line=anchor(op),
+                        )
+
+
+def _check_while(pm: ParsedModule, diags: Diagnostics) -> None:
+    """TL005: while body/condition parameter/result shape agreement."""
+    module = pm.module
+    for comp in module.computations.values():
+        for op in comp.ops:
+            if op.base != "while":
+                continue
+            line = pm.op_lines.get((comp.name, op.name))
+            body_name = op.attrs.get("body", "").lstrip("%")
+            cond_name = op.attrs.get("condition", "").lstrip("%")
+            want = _shape_key(op.result)
+            for role, name in (("body", body_name),
+                               ("condition", cond_name)):
+                sub = module.computations.get(name)
+                if sub is None:
+                    continue  # TL013 already reported missing targets
+                params = sub.parameters
+                if len(params) != 1:
+                    diags.emit(
+                        "TL005",
+                        f"{module.name}: while %{op.name} {role} "
+                        f"%{name} has {len(params)} parameters "
+                        f"(expected exactly 1)",
+                        file=pm.file, line=line,
+                    )
+                    continue
+                if _shape_key(params[0].result) != want:
+                    diags.emit(
+                        "TL005",
+                        f"{module.name}: while %{op.name} carries "
+                        f"{op.result} but {role} %{name} parameter is "
+                        f"{params[0].result}",
+                        file=pm.file, line=line,
+                    )
+                if role == "body" and sub.ops and \
+                        _shape_key(sub.root.result) != want:
+                    diags.emit(
+                        "TL005",
+                        f"{module.name}: while %{op.name} carries "
+                        f"{op.result} but body %{name} returns "
+                        f"{sub.root.result}",
+                        file=pm.file, line=line,
+                    )
+                if role == "condition" and sub.ops:
+                    r = sub.root.result
+                    if not (
+                        isinstance(r, TensorSpec)
+                        and r.dtype == "pred" and r.shape == ()
+                    ):
+                        diags.emit(
+                            "TL005",
+                            f"{module.name}: while %{op.name} "
+                            f"condition %{name} returns {r} "
+                            f"(expected pred[])",
+                            file=pm.file, line=line,
+                        )
+
+
+def _check_groups(
+    groups, n_devices: int | None, what: str, diags: Diagnostics,
+    file: str, line: int | None,
+) -> None:
+    """TL009 range/duplication + TL014 pod tiling, shared between module
+    collective ops and standalone collective commands."""
+    if not groups:
+        return
+    seen: dict[int, int] = {}
+    dups: set[int] = set()
+    for g in groups:
+        for member in g:
+            if member in seen:
+                dups.add(member)
+            seen[member] = seen.get(member, 0) + 1
+    if dups:
+        diags.emit(
+            "TL009",
+            f"{what}: device(s) {sorted(dups)} appear in more than one "
+            f"replica group (groups must be disjoint)",
+            file=file, line=line,
+        )
+    if n_devices is not None:
+        out = sorted(m for m in seen if not 0 <= m < n_devices)
+        if out:
+            diags.emit(
+                "TL009",
+                f"{what}: replica group member(s) {out} out of range "
+                f"for a {n_devices}-device pod",
+                file=file, line=line,
+            )
+        elif not dups and len(seen) != n_devices:
+            diags.emit(
+                "TL014",
+                f"{what}: replica groups cover {len(seen)} of "
+                f"{n_devices} devices (groups should tile the pod "
+                f"exactly)",
+                file=file, line=line,
+            )
+
+
+def _check_collectives(pm: ParsedModule, diags: Diagnostics) -> None:
+    """TL008 byte-count consistency + TL009/TL014 on module collectives."""
+    module = pm.module
+    for comp in module.computations.values():
+        pos = {op.name: i for i, op in enumerate(comp.ops)}
+        for i, op in enumerate(comp.ops):
+            base = base_opcode(op.opcode)
+            if base not in COLLECTIVE_OPCODES or op.collective is None:
+                continue
+            line = pm.op_lines.get((comp.name, op.name))
+            ci = op.collective
+            _check_groups(
+                ci.replica_groups, module.num_devices,
+                f"{module.name}/{comp.name}: {op.opcode} %{op.name}",
+                diags, pm.file, line,
+            )
+            # byte-count relation: sync ops with resolvable operands only
+            # (async -start results interpose buffer tuples; variadic
+            # forms compare the summed element counts)
+            if op.is_async_start or op.is_async_done:
+                continue
+            in_elems = 0.0
+            ok = bool(op.operands)
+            for operand in op.operands:
+                j = pos.get(operand)
+                if j is None or j >= i:
+                    ok = False
+                    break
+                in_elems += comp.ops[j].result.elems
+            if not ok:
+                continue
+            out_elems = float(op.result.elems)
+            gs = ci.group_size if ci.replica_groups else None
+            expect: float | None = None
+            if base == "all-reduce":
+                expect = in_elems
+            elif base == "all-gather" and gs:
+                expect = in_elems * gs
+            elif base == "reduce-scatter" and gs:
+                expect = in_elems / gs
+            if expect is not None and out_elems != expect:
+                diags.emit(
+                    "TL008",
+                    f"{module.name}/{comp.name}: {base} %{op.name} "
+                    f"result has {out_elems:g} elements; operands "
+                    f"({in_elems:g} elements"
+                    + (f", group size {gs}" if gs else "")
+                    + f") imply {expect:g}",
+                    file=pm.file, line=line,
+                )
+
+
+def _check_commands(pt: ParsedTrace, diags: Diagnostics) -> None:
+    """TL006/TL007/TL009/TL010/TL014/TL015 over commandlist.jsonl.
+
+    Range checks gate on the EXPLICIT ``meta.json`` pod declaration
+    (:attr:`ParsedTrace.meta_devices`): without one, the driver infers
+    the pod from the command lanes themselves and any device id is
+    self-consistent."""
+    from tpusim.ir import CommandKind
+
+    kinds = {k.value for k in CommandKind}
+    n_devices = pt.meta_devices
+    file = "commandlist.jsonl"
+    for lineno, rec, err in pt.commands:
+        if err is not None:
+            diags.emit("TL010", err, file=file, line=lineno)
+            continue
+        kind = rec.get("kind")
+        if kind not in kinds:
+            diags.emit(
+                "TL010",
+                f"unknown command kind {kind!r} "
+                f"(valid: {sorted(kinds)})",
+                file=file, line=lineno,
+            )
+            continue
+        device = rec.get("device", 0)
+        if not isinstance(device, int) or isinstance(device, bool):
+            diags.emit(
+                "TL010",
+                f"device id must be an integer, got {device!r}",
+                file=file, line=lineno,
+            )
+        elif device < 0:
+            diags.emit(
+                "TL007",
+                f"{kind} on device {device} — device ids cannot be "
+                f"negative",
+                file=file, line=lineno,
+            )
+        elif n_devices is not None and device >= n_devices:
+            diags.emit(
+                "TL007",
+                f"{kind} on device {device}, but the trace declares "
+                f"{n_devices} device(s)",
+                file=file, line=lineno,
+            )
+        if kind == "kernel_launch":
+            module = rec.get("module")
+            if module not in pt.modules:
+                diags.emit(
+                    "TL006",
+                    f"kernel_launch references module {module!r}; "
+                    f"trace carries {sorted(pt.modules)}",
+                    file=file, line=lineno,
+                )
+        if kind == "collective":
+            coll = rec.get("collective") or {}
+            groups = [
+                tuple(g) for g in coll.get("replica_groups", [])
+                if isinstance(g, (list, tuple))
+            ]
+            _check_groups(
+                groups, n_devices,
+                f"collective {coll.get('kind', '?')}",
+                diags, file, lineno,
+            )
+            nbytes = rec.get("bytes", 0)
+            if not nbytes:
+                diags.emit(
+                    "TL015",
+                    f"standalone {coll.get('kind', 'collective')} "
+                    f"carries zero bytes — it will be priced as free",
+                    file=file, line=lineno,
+                )
+
+
+def run_trace_passes(
+    pt: ParsedTrace, diags: Diagnostics, lenient: bool = True,
+) -> None:
+    """All trace-family passes over one loaded trace dir.
+
+    ``lenient`` mirrors the parse mode the replay would use: under the
+    DEFAULT strict loader a malformed HLO line is fatal mid-parse, so
+    TL012 escalates to error severity when ``lenient`` is False; a
+    lenient replay skips the line with a counted warning, and the
+    diagnostic stays at its registry (warning) severity."""
+    from tpusim.analysis.diagnostics import Severity
+
+    if pt.meta_error is not None:
+        diags.emit("TL010", pt.meta_error, file="meta.json", line=1)
+    launched = {
+        rec.get("module")
+        for _, rec, err in pt.commands
+        if err is None and rec.get("kind") == "kernel_launch"
+    }
+    for key, pm in sorted(pt.modules.items()):
+        if pm.module.entry_name is None and (
+            key in launched or not pt.has_commandlist
+        ):
+            diags.emit(
+                "TL011",
+                f"module {pm.module.name!r} has no ENTRY computation — "
+                f"the engine cannot replay it",
+                file=pm.file,
+                line=min(pm.comp_lines.values(), default=1),
+            )
+        for lineno, err in pm.skipped:
+            if lenient:
+                diags.emit(
+                    "TL012",
+                    f"malformed HLO line (the lenient parse skips it): "
+                    f"{err}",
+                    file=pm.file, line=lineno,
+                )
+            else:
+                diags.emit(
+                    "TL012",
+                    f"malformed HLO line (the strict parse the replay "
+                    f"uses will REJECT this module; pass "
+                    f"--lenient-parse to salvage): {err}",
+                    file=pm.file, line=lineno,
+                    severity=Severity.ERROR,
+                )
+        _check_dataflow(pm, diags)
+        _check_while(pm, diags)
+        _check_collectives(pm, diags)
+    _check_commands(pt, diags)

@@ -23,7 +23,7 @@ import math
 import sys
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Callable
+from typing import Callable
 
 __all__ = [
     "RefineResult", "KNOBS", "refine", "refine_arch_on_fixtures",

@@ -25,9 +25,7 @@ from __future__ import annotations
 import ctypes
 import heapq
 import math
-import os
 from dataclasses import dataclass
-from pathlib import Path
 from typing import TYPE_CHECKING, Iterable, Sequence
 
 from tpusim.ici.topology import Topology

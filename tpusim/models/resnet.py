@@ -12,7 +12,6 @@ ops that the ICI model times (the rebuild of the fork's traced
 
 from __future__ import annotations
 
-from functools import partial
 
 from tpusim.models.registry import register
 

@@ -23,7 +23,10 @@ from dataclasses import dataclass, field
 
 from tpusim.timing.engine import EngineResult
 
-__all__ = ["PowerCoefficients", "PowerModel", "PowerReport", "power_timeline", "dvfs_overlays", "POWER_PRESETS"]
+__all__ = [
+    "PowerCoefficients", "PowerModel", "PowerReport", "power_timeline",
+    "dvfs_overlays", "POWER_PRESETS",
+]
 
 
 @dataclass(frozen=True)

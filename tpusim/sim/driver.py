@@ -528,6 +528,7 @@ def simulate_trace(
     faults=None,
     topology: Topology | None = None,
     lenient: bool = False,
+    validate: str | bool | None = None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -539,11 +540,35 @@ def simulate_trace(
     sampling (None = the no-op hub).  ``faults`` is a fault schedule
     (``tpusim.faults.FaultSchedule`` / path / dict — the ``--faults``
     flag); ``lenient`` tolerates malformed HLO lines during parse (the
-    ``--lenient-parse`` flag)."""
+    ``--lenient-parse`` flag).  ``validate`` opts into the static
+    pre-flight (the ``--validate[=strict]`` flag): the trace, composed
+    config, and fault schedule run through ``tpusim.analysis`` first,
+    and error-level diagnostics (plus warnings under ``"strict"``)
+    raise :class:`tpusim.analysis.ValidationError` instead of pricing a
+    replay that would be silently wrong."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
     obs = obs if obs is not None else NULL_OBS
+    if validate:
+        from tpusim.analysis import (
+            Severity, ValidationError, analyze_trace_dir,
+        )
+
+        strict = validate == "strict"
+        with obs.span("validate"):
+            # the explicitly passed config/topology are what replays,
+            # so they are what gets analyzed; `lenient` decides whether
+            # salvage damage is fatal (strict parse) or a warning
+            diags = analyze_trace_dir(
+                trace_path, arch=arch, overlays=overlays,
+                faults=faults, tuned=tuned, config=config,
+                topology=topology, lenient=lenient,
+            )
+        if diags.has_errors or (
+            strict and diags.count(Severity.WARNING) > 0
+        ):
+            raise ValidationError(diags, strict=strict)
     with obs.span("parse"):
         pod = load_trace(trace_path, lenient=lenient)
     if arch is None and config is None:

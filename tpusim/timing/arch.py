@@ -34,7 +34,7 @@ from __future__ import annotations
 
 from tpusim.timing.config import ArchConfig, IciConfig
 
-__all__ = ["ARCH_PRESETS", "arch_preset", "detect_arch"]
+__all__ = ["ARCH_PRESETS", "arch_preset", "detect_arch", "match_device_kind"]
 
 
 def _v4() -> ArchConfig:
@@ -109,15 +109,22 @@ def arch_preset(name: str) -> ArchConfig:
     return ARCH_PRESETS[key]
 
 
-def detect_arch(device_kind: str) -> ArchConfig:
-    """Best-effort map of a jax ``device.device_kind`` to a preset
-    (``'TPU v5 lite'`` → v5e).  Falls back to v5e."""
+def match_device_kind(device_kind: str) -> str | None:
+    """Preset name a ``device_kind`` CONFIDENTLY maps to, or None when
+    it is unrecognized — callers that must not guess (the static
+    analyzer\'s trace/config agreement check) key on the None."""
     kind = device_kind.lower().strip()
     if kind in _DEVICE_KIND_MAP:
-        return arch_preset(_DEVICE_KIND_MAP[kind])
+        return _DEVICE_KIND_MAP[kind]
     for pat, preset in sorted(
         _DEVICE_KIND_MAP.items(), key=lambda kv: -len(kv[0])
     ):
         if kind.startswith(pat):
-            return arch_preset(preset)
-    return arch_preset("v5e")
+            return preset
+    return None
+
+
+def detect_arch(device_kind: str) -> ArchConfig:
+    """Best-effort map of a jax ``device.device_kind`` to a preset
+    (``'TPU v5 lite'`` → v5e).  Falls back to v5e."""
+    return arch_preset(match_device_kind(device_kind) or "v5e")

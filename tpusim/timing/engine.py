@@ -34,7 +34,7 @@ from tpusim.ir import (
     leaves_of,
 )
 from tpusim.timing.config import SimConfig
-from tpusim.timing.cost import CostModel, OpCost, while_trip_count
+from tpusim.timing.cost import CostModel, while_trip_count
 
 __all__ = ["Engine", "EngineResult", "TimelineEvent"]
 

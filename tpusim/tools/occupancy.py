@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from pathlib import Path
 
 from tpusim.ir import Computation, ModuleTrace, TraceOp
 from tpusim.timing.config import ArchConfig

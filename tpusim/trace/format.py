@@ -27,13 +27,17 @@ from pathlib import Path
 from tpusim.ir import (
     CollectiveInfo,
     CommandKind,
-    DeviceTrace,
-    ModuleTrace,
     PodTrace,
     TraceCommand,
 )
 
-__all__ = ["TraceDir", "save_trace", "load_trace", "parse_commandlist"]
+__all__ = [
+    "TraceDir",
+    "save_trace",
+    "load_trace",
+    "parse_commandlist",
+    "iter_commandlist",
+]
 
 TRACE_FORMAT_VERSION = 1
 
@@ -123,16 +127,39 @@ def command_from_json(d: dict) -> TraceCommand:
     )
 
 
+def iter_commandlist(path: str | Path):
+    """Yield ``(lineno, record_dict | None, error | None)`` per non-blank
+    ``commandlist.jsonl`` line (1-based line numbers).
+
+    The shared substrate of :func:`parse_commandlist` and the static
+    analyzer (``tpusim.analysis.trace_passes``): the loader wants the
+    records, the linter wants the *line anchors* and the per-line parse
+    errors — one walk serves both so they can never disagree about which
+    line a record came from."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                yield lineno, None, f"invalid JSON: {e}"
+                continue
+            if not isinstance(rec, dict):
+                yield lineno, None, f"record is not an object: {rec!r}"
+                continue
+            yield lineno, rec, None
+
+
 def parse_commandlist(path: str | Path) -> list[TraceCommand]:
     """Parse a ``commandlist.jsonl`` — the ``parse_commandlist_file``
     equivalent (``trace_parser.cc:220``)."""
     cmds = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            cmds.append(command_from_json(json.loads(line)))
+    for lineno, rec, err in iter_commandlist(path):
+        if err is not None:
+            raise ValueError(f"{path}:{lineno}: {err}")
+        cmds.append(command_from_json(rec))
     return cmds
 
 

@@ -30,6 +30,11 @@ from tpusim.ir import (
 
 __all__ = ["parse_hlo_module", "parse_shape", "split_top_level"]
 
+#: cap on distinct malformed-line samples kept in lenient-parse meta
+#: (``parse_skipped_samples``) — enough to diagnose, bounded for multi-GB
+#: traces where every line of a region is torn
+_SKIP_SAMPLE_CAP = 8
+
 
 # ---------------------------------------------------------------------------
 # Low-level tokenizing helpers
@@ -448,13 +453,24 @@ def parse_hlo_module(
     delimiters) is SKIPPED with a counted warning instead of raising
     mid-file — one corrupt line no longer loses a whole multi-GB trace.
     The skip count lands in ``module.meta['parse_skipped_lines']`` and a
-    single ``UserWarning`` summarizes the damage.  Strict (raising)
-    parsing remains the default: silent data loss must be opted into.
+    single ``UserWarning`` summarizes the damage; repeated copies of the
+    same corrupt line (a torn buffer flushed in a loop writes thousands
+    of identical ones) are DEDUPLICATED — the warning and the
+    ``parse_skipped_samples`` meta field carry only the distinct line
+    texts (first :data:`_SKIP_SAMPLE_CAP`), with
+    ``parse_skipped_distinct`` holding the distinct count.  The static
+    analyzer surfaces the same damage as a warning-level ``TL012``
+    diagnostic (``tpusim lint``).  Strict (raising) parsing remains the
+    default: silent data loss must be opted into.
     """
     module = ModuleTrace(name=name_hint)
     current: Computation | None = None
     skipped = 0
-    first_error: str | None = None
+    # distinct corrupt lines are tracked by HASH (O(1) memory per line,
+    # not the line text — a multi-GB damaged region must not be held in
+    # RAM); only the first few full texts are kept as samples
+    skipped_hashes: set[int] = set()
+    skipped_samples: list[str] = []
 
     for raw in text.splitlines():
         line = raw.rstrip()
@@ -501,8 +517,13 @@ def parse_hlo_module(
                         f"{stripped[:120]!r}: {e}"
                     ) from e
                 skipped += 1
-                if first_error is None:
-                    first_error = f"{stripped[:80]!r}: {e}"
+                h = hash(stripped)
+                if h not in skipped_hashes:
+                    skipped_hashes.add(h)
+                    if len(skipped_samples) < _SKIP_SAMPLE_CAP:
+                        skipped_samples.append(
+                            f"{stripped[:80]!r}: {e}"
+                        )
                 continue
             if op is not None:
                 current.add(op)
@@ -513,9 +534,12 @@ def parse_hlo_module(
         import warnings
 
         module.meta["parse_skipped_lines"] = skipped
+        module.meta["parse_skipped_distinct"] = len(skipped_hashes)
+        module.meta["parse_skipped_samples"] = list(skipped_samples)
         warnings.warn(
             f"lenient HLO parse of {module.name!r}: skipped {skipped} "
-            f"malformed line(s); first: {first_error}",
+            f"malformed line(s) ({len(skipped_hashes)} distinct); "
+            f"first: {skipped_samples[0]}",
             UserWarning,
             stacklevel=2,
         )

@@ -20,7 +20,6 @@ the engine's capacity pass, which uses the raw-text ``S(1)`` scan
 
 from __future__ import annotations
 
-import math
 import re
 
 from tpusim.ir import FREE_OPCODES, ModuleTrace
