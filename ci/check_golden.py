@@ -314,6 +314,92 @@ def lint_smoke() -> dict:
     return {"artifacts": checked, "codes": len(CODES)}
 
 
+#: stats the perf layer adds only when active — stripped before golden
+#: comparison (the determinism contract covers the simulation stats,
+#: not the layer's own accounting)
+PERF_KEY_PREFIXES = ("cache_", "pool_")
+
+
+def perf_smoke() -> dict:
+    """Performance-layer determinism contract (tpusim.perf):
+
+    1. the full golden matrix replayed with ``--workers 4`` and an
+       on-disk result cache must reproduce the committed serial goldens
+       byte-for-byte (modulo the layer's own ``cache_*``/``pool_*``
+       accounting keys);
+    2. a warm-cache second pass over the matrix must execute ZERO engine
+       pricing walks — every module result comes from the cache;
+    3. warm stats must equal cold stats exactly.
+    Raises on violation."""
+    import tempfile
+    import time
+
+    from tpusim.sim.driver import simulate_trace
+    from tpusim.timing.engine import Engine
+
+    runs = {"n": 0}
+    orig_run = Engine.run
+
+    def counting_run(self, module):
+        runs["n"] += 1
+        return orig_run(self, module)
+
+    def run_matrix_perf(cache_dir: str, workers: int):
+        out = {}
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            report = simulate_trace(
+                FIXTURES / fixture, arch=arch, overlays=list(overlays),
+                tuned=False, workers=workers, result_cache=cache_dir,
+            )
+            out[name] = {
+                k: v for k, v in json.loads(report.stats.to_json()).items()
+                if k not in VOLATILE
+                and not k.startswith(PERF_KEY_PREFIXES)
+            }
+        return out
+
+    Engine.run = counting_run
+    try:
+        with tempfile.TemporaryDirectory(prefix="tpusim_perf_smoke_") as td:
+            t0 = time.perf_counter()
+            cold = run_matrix_perf(td, workers=4)
+            cold_s = time.perf_counter() - t0
+            errors = compare(cold)
+            if errors:
+                raise ValueError(
+                    "parallel+cached matrix diverged from committed "
+                    "serial goldens:\n  " + "\n  ".join(errors)
+                )
+            runs["n"] = 0
+            t0 = time.perf_counter()
+            warm = run_matrix_perf(td, workers=4)
+            warm_s = time.perf_counter() - t0
+            if runs["n"] != 0:
+                raise ValueError(
+                    f"warm-cache matrix still executed {runs['n']} "
+                    f"engine pricing walks (expected 0)"
+                )
+            if warm != cold:
+                diff = [
+                    n for n in cold
+                    if warm.get(n) != cold[n]
+                ]
+                raise ValueError(
+                    f"warm-cache stats diverged from cold for {diff}"
+                )
+    finally:
+        Engine.run = orig_run
+    return {
+        "configs": len(cold),
+        "cold_s": cold_s,
+        "warm_s": warm_s,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -329,7 +415,25 @@ def main(argv: list[str] | None = None) -> int:
                     help="run tpusim lint over every checked-in golden "
                          "trace/config/fault-schedule and require zero "
                          "error-level diagnostics")
+    ap.add_argument("--perf-smoke", action="store_true",
+                    help="replay the golden matrix with --workers 4 and "
+                         "an on-disk result cache: must match the "
+                         "committed serial goldens byte-for-byte, and a "
+                         "warm-cache second pass must run zero engine "
+                         "pricing walks")
     args = ap.parse_args(argv)
+
+    if args.perf_smoke:
+        try:
+            summary = perf_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --perf-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --perf-smoke: OK ({summary['configs']} "
+              f"configs bit-identical under workers=4 + cache; "
+              f"cold {summary['cold_s']:.2f}s -> warm "
+              f"{summary['warm_s']:.2f}s, zero warm engine runs)")
+        return 0
 
     if args.lint_smoke:
         try:

@@ -21,40 +21,47 @@
 #   7. tlint   — trace/config/schedule lint smoke: `tpusim lint` over
 #                every checked-in golden artifact must report zero
 #                error-level diagnostics (ci/check_golden --lint-smoke)
-#   8. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#   8. perf    — performance-layer determinism: the golden matrix under
+#                --workers 4 + an on-disk result cache must match the
+#                committed serial goldens byte-for-byte, and a warm-
+#                cache pass must run zero engine pricing walks
+#   9. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-7
+# Usage:  bash ci/run_ci.sh            # tiers 1-8
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/8] build native ==="
+echo "=== [1/9] build native ==="
 make -C native
 
-echo "=== [2/8] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/9] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/8] unit tests (fast tier) ==="
+echo "=== [3/9] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/8] golden-stat regression sims ==="
+echo "=== [4/9] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/8] obs export smoke (schema-checked) ==="
+echo "=== [5/9] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/8] faults smoke (degraded-pod contract) ==="
+echo "=== [6/9] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/8] trace/config/schedule lint smoke ==="
+echo "=== [7/9] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
+echo "=== [8/9] perf smoke (parallel+cached determinism) ==="
+python ci/check_golden.py --perf-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [8/8] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [9/9] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [8/8] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [9/9] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

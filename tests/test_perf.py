@@ -1,0 +1,463 @@
+"""tpusim.perf — result cache + worker pool contracts.
+
+The layer's whole promise is "free speed": parallel and cached replays
+must be bit-identical to the serial path (stats dict equality), the
+cache must invalidate on exactly the things that change a price (config
+overlays, model version, degraded-chip multipliers), a damaged disk
+record must degrade to a recompute with a warning, and the sweep's
+shared cache must price the healthy-kernel class exactly once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from tpusim.perf.cache import (
+    CachedEngine,
+    ResultCache,
+    config_fingerprint,
+    module_fingerprint,
+    result_from_doc,
+    result_to_doc,
+)
+from tpusim.perf.pool import map_ordered, resolve_workers
+from tpusim.timing.config import load_config, overlay
+from tpusim.timing.engine import Engine
+from tpusim.trace.format import load_trace
+
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+
+#: host-dependent stats + the perf layer's own accounting (present only
+#: when the feature is on — the documented determinism contract)
+_VOLATILE = ("simulation_rate_kops", "silicon_slowdown")
+_PERF_PREFIXES = ("cache_", "pool_")
+
+
+def _stats(report) -> dict:
+    return {
+        k: v for k, v in json.loads(report.stats.to_json()).items()
+        if k not in _VOLATILE and not k.startswith(_PERF_PREFIXES)
+    }
+
+
+def _count_engine_runs(monkeypatch):
+    """Patch Engine.run to count actual pricing walks (cache hits return
+    before reaching it)."""
+    calls = {"n": 0}
+    orig = Engine.run
+
+    def counting(self, module):
+        calls["n"] += 1
+        return orig(self, module)
+
+    monkeypatch.setattr(Engine, "run", counting)
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# pool
+# ---------------------------------------------------------------------------
+
+
+def _double(x):
+    return x * 2
+
+
+def _pid_of(x):
+    return os.getpid()
+
+
+def test_workers_one_short_circuits_pool():
+    """workers<=1 must run in-process: no fork, no pickling (the fn here
+    is a closure — unpicklable — and still works)."""
+    seen = []
+    out = map_ordered(lambda x: seen.append(x) or x + 1, [1, 2, 3],
+                      workers=1)
+    assert out == [2, 3, 4]
+    assert seen == [1, 2, 3]
+    pids = map_ordered(_pid_of, [0, 1], workers=1)
+    assert set(pids) == {os.getpid()}
+
+
+def test_pool_parallel_preserves_order_and_forks():
+    out = map_ordered(_double, list(range(20)), workers=4)
+    assert out == [x * 2 for x in range(20)]
+    pids = map_ordered(_pid_of, list(range(8)), workers=4)
+    assert os.getpid() not in pids  # work really left the parent
+
+
+def test_nested_serial_map_preserves_outer_context():
+    """A nested serial map (a sweep worker whose own fan-out degrades to
+    serial) must not clobber the outer call's pool context."""
+    from tpusim.perf.pool import pool_context
+
+    def outer(x):
+        ctx = pool_context()
+        map_ordered(lambda y: y, [1, 2], workers=1, context="inner")
+        assert pool_context() == ctx
+        return ctx
+
+    assert map_ordered(outer, [1, 2, 3], workers=1,
+                       context="outer") == ["outer"] * 3
+
+
+def test_task_exception_propagates_not_swallowed():
+    """A task failure (OSError from a missing trace, say) must reach the
+    caller as-is, not be misread as pool failure and re-run serially.
+
+    Runs in a pristine subprocess: this suite has jax's thread pools
+    loaded, and forking under them is exactly what real pool callers
+    (the jax-free replay paths) never do — the flake is the harness's,
+    not the pool's."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from tpusim.envutil import REPO_ROOT, cpu_mesh_env
+
+    code = textwrap.dedent("""
+        import tpusim.perf.pool as P
+
+        def boom(x):
+            raise OSError(f"task {x} failed")
+
+        def no_serial(fn, items, context):
+            raise AssertionError("fell back to a serial re-run")
+
+        P._serial = no_serial
+        try:
+            P.map_ordered(boom, [0, 1, 2, 3], workers=2)
+        except OSError as e:
+            assert "task" in str(e), e
+        else:
+            raise AssertionError("task OSError did not propagate")
+        print("OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env=cpu_mesh_env(1), cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0 and "OK" in proc.stdout, (
+        proc.stdout + proc.stderr
+    )
+
+
+def test_resolve_workers_env(monkeypatch):
+    monkeypatch.delenv("TPUSIM_WORKERS", raising=False)
+    assert resolve_workers(None) == 1
+    assert resolve_workers(3) == 3
+    monkeypatch.setenv("TPUSIM_WORKERS", "5")
+    assert resolve_workers(None) == 5
+    assert resolve_workers(2) == 2   # explicit beats env
+    monkeypatch.setenv("TPUSIM_WORKERS", "garbage")
+    assert resolve_workers(None) == 1
+
+
+# ---------------------------------------------------------------------------
+# cache keys + hit/invalidation
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_and_config_invalidation():
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache()
+
+    r1 = CachedEngine(cfg, result_cache=cache).run(mod)
+    assert (cache.hits, cache.misses) == (0, 1)
+    r2 = CachedEngine(cfg, result_cache=cache).run(mod)
+    assert r2 is r1
+    assert (cache.hits, cache.misses) == (1, 1)
+
+    # a config overlay changes the composed-config fingerprint -> miss
+    cfg2 = overlay(cfg, {"arch": {"hbm_efficiency": 0.5}})
+    assert config_fingerprint(cfg2) != config_fingerprint(cfg)
+    r3 = CachedEngine(cfg2, result_cache=cache).run(mod)
+    assert cache.misses == 2
+    assert r3.cycles != r1.cycles
+
+    # degraded-chip multipliers are their own cache class
+    r4 = CachedEngine(
+        cfg, clock_scale=0.5, result_cache=cache
+    ).run(mod)
+    assert cache.misses == 3
+    assert r4.cycles > r1.cycles
+
+
+def test_custom_cost_model_bypasses_cache():
+    """A caller-supplied cost model is outside the cache key, so such an
+    engine must never share results with the default-model population."""
+    from tpusim.timing.cost import CostModel
+
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache()
+    CachedEngine(cfg, result_cache=cache).run(mod)
+    assert cache.misses == 1
+    custom = CachedEngine(
+        cfg, cost_model=CostModel(cfg.arch), result_cache=cache,
+    )
+    custom.run(mod)
+    # neither a hit against the default population nor a poisoning put
+    assert cache.hits == 0 and cache.misses == 1
+    assert len(cache._mem) == 1
+
+
+def test_cache_invalidates_on_model_version_bump(monkeypatch):
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache()
+    key_now = cache.key_for(mod, cfg)
+    monkeypatch.setattr(
+        "tpusim.perf.cache.model_version", lambda: "bumped-model"
+    )
+    bumped = ResultCache()
+    key_bumped = bumped.key_for(mod, cfg)
+    assert key_now != key_bumped
+    # parser/IR edits (outside MODEL_FILES) must invalidate too: the
+    # text hash can't see a FREE_OPCODES or trip-count parsing fix
+    monkeypatch.setattr(
+        "tpusim.perf.cache.parser_version", lambda: "parser-a"
+    )
+    pa = ResultCache().key_for(mod, cfg)
+    monkeypatch.setattr(
+        "tpusim.perf.cache.parser_version", lambda: "parser-b"
+    )
+    pb = ResultCache().key_for(mod, cfg)
+    assert pa != pb
+
+
+def test_capture_platform_joins_cache_key():
+    """Identical HLO text captured on cpu vs tpu prices differently (the
+    cost model's capture-backend dtype normalization) — the key must
+    separate them or a shared cache cross-serves wrong results."""
+    pod_a = load_trace(FIXTURES / "matmul_512")
+    pod_b = load_trace(FIXTURES / "matmul_512")
+    mod_a = next(iter(pod_a.modules.values()))
+    mod_b = next(iter(pod_b.modules.values()))
+    mod_b.meta["platform"] = "tpu"
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache()
+    assert mod_a.meta.get("platform") != mod_b.meta.get("platform")
+    assert cache.key_for(mod_a, cfg) != cache.key_for(mod_b, cfg)
+
+
+def test_module_fingerprint_stamped_by_load_trace():
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    assert mod.meta.get("content_hash")
+    assert module_fingerprint(mod) == mod.meta["content_hash"]
+
+
+def test_result_doc_round_trip_is_exact():
+    pod = load_trace(FIXTURES / "llama_tiny_tp2dp2")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5p", tuned=False)
+    res = Engine(cfg).run(mod)
+    back = result_from_doc(json.loads(json.dumps(result_to_doc(res))))
+    assert result_to_doc(back) == result_to_doc(res)
+    assert back.cycles == res.cycles
+    assert back.op_count == res.op_count
+    assert isinstance(back.op_count, int)
+    assert dict(back.per_op_cycles) == dict(res.per_op_cycles)
+
+
+# ---------------------------------------------------------------------------
+# disk tier
+# ---------------------------------------------------------------------------
+
+
+def test_disk_cache_warm_run_skips_engine(tmp_path, monkeypatch):
+    from tpusim.sim.driver import simulate_trace
+
+    cache_dir = tmp_path / "cache"
+    cold = simulate_trace(
+        FIXTURES / "llama_tiny_tp2dp2", arch="v5p", tuned=False,
+        result_cache=cache_dir,
+    )
+    assert list(cache_dir.glob("*.json")), "disk tier wrote nothing"
+    calls = _count_engine_runs(monkeypatch)
+    warm = simulate_trace(
+        FIXTURES / "llama_tiny_tp2dp2", arch="v5p", tuned=False,
+        result_cache=cache_dir,
+    )
+    assert calls["n"] == 0, "warm-cache run still priced modules"
+    assert _stats(warm) == _stats(cold)
+    assert warm.stats.get("cache_hits") == 1
+    assert warm.stats.get("cache_disk_hits") == 1
+
+
+def test_corrupt_disk_entry_recomputes_with_warning(tmp_path):
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache_dir = tmp_path / "cache"
+
+    c1 = ResultCache(disk_dir=cache_dir)
+    r1 = CachedEngine(cfg, result_cache=c1).run(mod)
+    entries = list(cache_dir.glob("*.json"))
+    assert len(entries) == 1
+    # truncate the record mid-document
+    entries[0].write_text(entries[0].read_text()[: 40])
+
+    c2 = ResultCache(disk_dir=cache_dir)
+    with pytest.warns(RuntimeWarning, match="corrupt result-cache"):
+        r2 = CachedEngine(cfg, result_cache=c2).run(mod)
+    assert c2.disk_errors == 1
+    assert c2.misses == 1 and c2.hits == 0
+    assert r2.cycles == r1.cycles  # recomputed, not garbage
+    # the recompute healed the record: a third cache disk-hits it
+    c3 = ResultCache(disk_dir=cache_dir)
+    r3 = CachedEngine(cfg, result_cache=c3).run(mod)
+    assert c3.disk_hits == 1
+    assert r3.cycles == r1.cycles
+
+
+def test_stale_format_version_is_silent_miss(tmp_path):
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache_dir = tmp_path / "cache"
+    c1 = ResultCache(disk_dir=cache_dir)
+    CachedEngine(cfg, result_cache=c1).run(mod)
+    entry = next(cache_dir.glob("*.json"))
+    doc = json.loads(entry.read_text())
+    doc["format_version"] = 999
+    entry.write_text(json.dumps(doc))
+    c2 = ResultCache(disk_dir=cache_dir)
+    CachedEngine(cfg, result_cache=c2).run(mod)  # no warning expected
+    assert c2.disk_errors == 0
+    assert c2.misses == 1
+
+
+def test_lru_eviction_counts():
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cache = ResultCache(max_entries=1)
+    cfg_a = load_config(arch="v5e", tuned=False)
+    cfg_b = overlay(cfg_a, {"arch": {"hbm_efficiency": 0.5}})
+    CachedEngine(cfg_a, result_cache=cache).run(mod)
+    CachedEngine(cfg_b, result_cache=cache).run(mod)
+    assert cache.evictions == 1
+    # cfg_a was evicted: re-running it misses again
+    CachedEngine(cfg_a, result_cache=cache).run(mod)
+    assert cache.misses == 3
+
+
+# ---------------------------------------------------------------------------
+# serial vs parallel bit-identity (driver + sweeps)
+# ---------------------------------------------------------------------------
+
+
+def _two_module_trace(tmp_path: Path) -> Path:
+    """A pod trace with two distinct modules so the driver's
+    segment-parallel pricing path actually engages (>1 launch class)."""
+    import shutil
+
+    src = FIXTURES / "matmul_512"
+    dst = tmp_path / "two_mod"
+    (dst / "modules").mkdir(parents=True)
+    hlo = (src / "modules" / "matmul_512.hlo").read_text()
+    (dst / "modules" / "mm_a.hlo").write_text(hlo)
+    (dst / "modules" / "mm_b.hlo").write_text(
+        hlo.replace("f32[512,512]", "f32[256,512]", 1)
+    )
+    shutil.copy(src / "meta.json", dst / "meta.json")
+    cmds = [
+        {"kind": "kernel_launch", "module": "mm_a", "device": 0},
+        {"kind": "kernel_launch", "module": "mm_b", "device": 0},
+        {"kind": "kernel_launch", "module": "mm_a", "device": 0},
+    ]
+    (dst / "commandlist.jsonl").write_text(
+        "\n".join(json.dumps(c) for c in cmds) + "\n"
+    )
+    return dst
+
+
+def test_driver_serial_vs_parallel_bit_identity(tmp_path):
+    from tpusim.sim.driver import simulate_trace
+
+    trace = _two_module_trace(tmp_path)
+    serial = simulate_trace(trace, arch="v5e", tuned=False)
+    par = simulate_trace(trace, arch="v5e", tuned=False, workers=4)
+    assert _stats(par) == _stats(serial)
+    # the pool really engaged and said so
+    assert par.stats.get("pool_workers") == 4
+    assert par.stats.get("pool_parallel_segments") == 2
+    assert serial.stats.get("pool_workers") is None  # off by default
+
+
+def test_sweep_serial_parallel_cached_byte_identity():
+    from tpusim.faults.sweep import single_link_sweep, trace_step_sweep
+    from tpusim.ici.topology import torus_for
+    from tpusim.timing.config import load_config as _lc
+
+    topo = torus_for(8, "v5p")
+    serial = trace_step_sweep(
+        FIXTURES / "llama_tiny_tp2dp2", topo, arch="v5p",
+        max_scenarios=6, tuned=False,
+    )
+    par = trace_step_sweep(
+        FIXTURES / "llama_tiny_tp2dp2", topo, arch="v5p",
+        max_scenarios=6, tuned=False, workers=4,
+    )
+    assert json.dumps(serial.to_doc()) == json.dumps(par.to_doc())
+
+    cfg = _lc(arch="v5p", tuned=False)
+    a_serial = single_link_sweep(topo, cfg.arch.ici)
+    a_par = single_link_sweep(topo, cfg.arch.ici, workers=4)
+    assert json.dumps(a_serial.to_doc()) == json.dumps(a_par.to_doc())
+
+
+def test_sweep_prices_healthy_class_exactly_once(monkeypatch):
+    """The double-pricing fix: a collective-free trace swept over N
+    dead-link scenarios runs the engine ONCE (baseline), not N+1 times
+    — link faults cannot change a collective-free module's price."""
+    from tpusim.faults.sweep import trace_step_sweep
+    from tpusim.ici.topology import torus_for
+
+    calls = _count_engine_runs(monkeypatch)
+    result = trace_step_sweep(
+        FIXTURES / "matmul_512", torus_for(8, "v5p"), arch="v5p",
+        max_scenarios=8, tuned=False,
+    )
+    assert len(result.rows) == 8
+    assert calls["n"] == 1, (
+        f"healthy-kernel class priced {calls['n']}x across the sweep "
+        f"(expected once)"
+    )
+    # and the physics agrees: no collective, no inflation
+    assert all(r.inflation == 1.0 for r in result.rows)
+
+
+def test_healthy_run_adds_no_perf_keys():
+    """No cache, no workers -> the report is key-identical to PR 3."""
+    from tpusim.sim.driver import simulate_trace
+
+    report = simulate_trace(
+        FIXTURES / "llama_tiny_tp2dp2", arch="v5p", tuned=False,
+    )
+    leaked = [
+        k for k in report.stats.values
+        if k.startswith(("cache_", "pool_"))
+    ]
+    assert leaked == []
+
+
+def test_perf_namespaces_registered():
+    from tpusim.analysis.statskeys import (
+        DOCUMENTED_UPDATE_PREFIXES, STATS_NAMESPACES,
+    )
+
+    assert "cache_" in STATS_NAMESPACES
+    assert "pool_" in STATS_NAMESPACES
+    assert "cache_" in DOCUMENTED_UPDATE_PREFIXES
+    assert "pool_" in DOCUMENTED_UPDATE_PREFIXES

@@ -52,6 +52,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         args.trace, arch=args.arch, overlays=overlays, obs=obs,
         faults=faults, lenient=args.lenient_parse,
         validate=args.validate,
+        result_cache=args.result_cache, workers=args.workers,
     )
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -129,6 +130,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         power=args.power,
         obs=args.obs,
         monitor_interval_s=args.monitor_interval,
+        result_cache=args.result_cache,
     )
     failed = rows.get("__failed__", {}).get("runs", [])
     ok = {k: v for k, v in rows.items() if k != "__failed__"}
@@ -237,7 +239,7 @@ def _cmd_correl_regen(args: argparse.Namespace) -> int:
     out = args.out or args.artifact
     doc = regenerate_offline(
         args.artifact, fixture_dir=args.fixtures, arch=args.arch,
-        out_path=out,
+        out_path=out, workers=args.workers,
     )
     print(
         f"correl-regen: {len(doc['workloads'])} workloads, "
@@ -287,7 +289,10 @@ def _cmd_profile(args: argparse.Namespace) -> int:
     with obs.span("init"):
         from tpusim.sim.driver import simulate_trace
 
-    report = simulate_trace(args.trace, arch=args.arch, obs=obs)
+    report = simulate_trace(
+        args.trace, arch=args.arch, obs=obs,
+        result_cache=args.result_cache,
+    )
 
     with obs.span("report"):
         totals = report.totals
@@ -306,6 +311,14 @@ def _cmd_profile(args: argparse.Namespace) -> int:
     print()
     for line in obs.profile_lines(total_wall):
         print(line)
+    if args.result_cache is not None:
+        # cache effectiveness (tpusim.perf): hits mean skipped engine
+        # walks — the whole point of profiling a cached replay
+        s = report.stats
+        print(f"  result cache: {s.get('cache_hits', 0):.0f} hits, "
+              f"{s.get('cache_misses', 0):.0f} misses "
+              f"({s.get('cache_disk_hits', 0):.0f} from disk, "
+              f"{s.get('cache_disk_errors', 0):.0f} corrupt)")
     print()
     print(f"top {len(op_rows)} costliest ops "
           f"(of {totals.op_count} simulated):")
@@ -339,6 +352,7 @@ def _cmd_faults(args: argparse.Namespace) -> int:
         result = trace_step_sweep(
             args.trace, topo, arch=args.arch,
             max_scenarios=args.max_scenarios,
+            workers=args.workers, result_cache=args.result_cache,
         )
         what = f"step time ({result.unit})"
     else:
@@ -346,6 +360,7 @@ def _cmd_faults(args: argparse.Namespace) -> int:
             topo, cfg.arch.ici,
             payload_bytes=args.payload_mb * 1024 * 1024,
             kind=args.kind,
+            workers=args.workers,
         )
         what = f"{args.kind} ({result.unit})"
     dims = "x".join(str(d) for d in topo.dims)
@@ -736,6 +751,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="skip malformed HLO lines with a counted "
                          "warning instead of raising mid-file (salvage "
                          "mode for damaged captures)")
+    ps.add_argument("--workers", type=int, default=None, metavar="N",
+                    help="fan module pricing over N processes "
+                         "(default: $TPUSIM_WORKERS, else serial); "
+                         "bit-identical to the serial replay")
+    ps.add_argument("--result-cache", nargs="?", const=True, default=None,
+                    metavar="DIR",
+                    help="memoize engine results on disk (tpusim.perf; "
+                         "default dir .tpusim_cache/): a warm re-run "
+                         "prices nothing and reproduces the same stats "
+                         "byte-for-byte; stamps cache_* stats")
     ps.add_argument("--validate", nargs="?", const="on", default=None,
                     choices=["on", "strict"], metavar="on|strict",
                     help="pre-flight the trace/config/schedule through "
@@ -782,6 +807,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="write per-run obs exports (samples.jsonl, "
                          "trace.json, metrics.prom) under each run dir")
     pr.add_argument("--monitor-interval", type=float, default=10.0)
+    pr.add_argument("--result-cache", default=None, metavar="DIR",
+                    help="shared on-disk engine-result cache every "
+                         "simulate cell mounts (repeat cells price "
+                         "nothing)")
     pr.set_defaults(fn=_cmd_run)
 
     pco = sub.add_parser(
@@ -837,6 +866,9 @@ def main(argv: list[str] | None = None) -> int:
     pcr.add_argument("--arch", default="v5e")
     pcr.add_argument("--out", default=None,
                      help="output path (default: overwrite --artifact)")
+    pcr.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="fan per-workload replays over N processes "
+                          "(byte-identical artifact)")
     pcr.set_defaults(fn=_cmd_correl_regen)
 
     pp = sub.add_parser(
@@ -848,6 +880,10 @@ def main(argv: list[str] | None = None) -> int:
     pp.add_argument("--arch", default=None)
     pp.add_argument("--top", type=int, default=10,
                     help="how many costliest ops to print")
+    pp.add_argument("--result-cache", nargs="?", const=True, default=None,
+                    metavar="DIR",
+                    help="profile a cached replay (tpusim.perf) and "
+                         "report cache effectiveness")
     pp.set_defaults(fn=_cmd_profile)
 
     pfa = sub.add_parser(
@@ -872,6 +908,17 @@ def main(argv: list[str] | None = None) -> int:
                      help="how many worst links to print")
     pfa.add_argument("--json", default=None,
                      help="write the full sweep report here")
+    pfa.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="fan per-link scenarios over N processes "
+                          "(default: $TPUSIM_WORKERS, else serial); "
+                          "rows merge in link order — byte-identical "
+                          "to the serial sweep")
+    pfa.add_argument("--result-cache", nargs="?", const=True, default=None,
+                     metavar="DIR",
+                     help="share one engine-result cache across the "
+                          "sweep's replays (--trace sweeps; in-memory "
+                          "sharing is always on, this adds the disk "
+                          "tier)")
     pfa.set_defaults(fn=_cmd_faults)
 
     pli = sub.add_parser(

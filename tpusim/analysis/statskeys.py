@@ -48,6 +48,19 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/ici/", "tpusim/obs/", "tpusim/timing/engine.py",
         "tpusim/sim/driver.py",
     ),
+    # the performance layer (PR 4): result-cache effectiveness
+    # (hits/misses/evictions + disk tier) — stamped by the driver only
+    # when a cache is active, mirrored as obs counters by tpusim.perf
+    "cache_": (
+        "tpusim/perf/", "tpusim/sim/driver.py", "tpusim/__main__.py",
+        "bench.py", "ci/check_golden.py",
+    ),
+    # worker-pool accounting (worker count, parallel segments) — stamped
+    # by the driver only when the pool actually engaged
+    "pool_": (
+        "tpusim/perf/", "tpusim/sim/driver.py", "tpusim/__main__.py",
+        "ci/check_golden.py",
+    ),
 }
 
 #: keys deliberately shared across surfaces, with the subsystems licensed
@@ -65,6 +78,17 @@ DOCUMENTED_UPDATE_PREFIXES = frozenset(
     set(STATS_NAMESPACES) | {"", "tot_"}
 )
 
+#: namespaces whose keys are shared FIELD FAMILIES by design (many
+#: writers, one meaning) and therefore exempt from the one-writer
+#: collision audit; every other registered namespace is owned
+SHARED_FIELD_FAMILIES = frozenset({"ici_"})
+
+#: single-writer namespaces for the collision pass — derived from the
+#: registry so a newly registered prefix is audited automatically
+_OWNED_PREFIXES = tuple(
+    sorted(set(STATS_NAMESPACES) - SHARED_FIELD_FAMILIES)
+)
+
 #: the source files whose stats-key surface is audited
 AUDIT_GLOBS = (
     "tpusim/sim/stats.py",
@@ -73,11 +97,15 @@ AUDIT_GLOBS = (
     "tpusim/obs/*.py",
     "tpusim/faults/*.py",
     "tpusim/ici/*.py",
+    "tpusim/perf/*.py",
     "tpusim/timing/engine.py",
 )
 
+#: reserved-key literal matcher, derived from the namespace registry so
+#: a prefix registered above is audited automatically
 _KEY_RE = re.compile(
-    r"""["']((?:obs|faults|ici)_[a-z0-9_.]+)["']"""
+    r"""["']((?:%s)_[a-z0-9_.]+)["']"""
+    % "|".join(sorted(p.rstrip("_") for p in STATS_NAMESPACES))
 )
 _PREFIX_KWARG_RE = re.compile(
     r"""prefix\s*=\s*["']([a-z0-9_.]*)["']"""
@@ -159,8 +187,8 @@ def run_statskey_passes(
     # cross-subsystem collision: the same reserved key minted by two
     # different packages means two writers race for one report line
     for key, rels in sorted(found.items()):
-        if not key.startswith(("obs_", "faults_")):
-            continue  # ici_* is a shared field family by design
+        if not key.startswith(_OWNED_PREFIXES):
+            continue  # shared field families (ici_*) are multi-writer
         subsystems = {
             _subsystem(r) for r in rels if not r.startswith("ci/")
         }

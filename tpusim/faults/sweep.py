@@ -13,6 +13,14 @@ Two sweep grains, both deterministic:
   replays, so callers cap scenarios (``max_scenarios``); scenario order
   is deterministic (sorted links).
 
+Both fan out over :mod:`tpusim.perf.pool` when ``workers`` is set, and
+the trace sweep threads ONE shared :class:`tpusim.perf.ResultCache`
+through every per-link driver, so the healthy-kernel class (modules
+whose price cannot depend on a link — no collectives) is priced exactly
+once per sweep instead of once per scenario.  Scenario rows merge in
+link order on every path, so serial, parallel, and cached sweeps emit
+byte-identical reports (pinned by tests/test_perf.py).
+
 The CLI front end is ``python -m tpusim faults``.
 """
 
@@ -24,6 +32,7 @@ from pathlib import Path
 from tpusim.faults.schedule import FaultSchedule, load_fault_schedule
 from tpusim.ici.collectives import CollectiveModel
 from tpusim.ici.topology import Topology
+from tpusim.perf.pool import map_ordered, pool_context
 
 __all__ = [
     "SweepRow",
@@ -89,25 +98,39 @@ class SweepResult:
         }
 
 
+def _analytic_link_worker(link: tuple[int, int]) -> float:
+    """Price the sweep collective with one link dead (pool worker)."""
+    topo, ici_cfg, info, payload_bytes = pool_context()
+    a, b = link
+    view = link_down_schedule(topo, a, b).bind(topo).view_at(0.0)
+    model = CollectiveModel(topo.with_faults(view), ici_cfg)
+    return model.seconds(info, payload_bytes)
+
+
 def single_link_sweep(
     topo: Topology,
     ici_cfg,
     payload_bytes: float = 64 * 1024 * 1024,
     kind: str = "all-reduce",
+    workers: int | None = None,
 ) -> SweepResult:
     """Price ``kind`` over the full pod once per dead link.  The healthy
     baseline uses the same analytic model on the same topology, so any
-    inflation is purely the fault fallback (mesh bandwidth terms)."""
+    inflation is purely the fault fallback (mesh bandwidth terms).
+    ``workers`` fans the per-link scenarios over a process pool; rows
+    merge in link order either way."""
     from tpusim.ir import CollectiveInfo
 
     n = topo.num_chips
     info = CollectiveInfo(kind, replica_groups=(tuple(range(n)),))
     healthy = CollectiveModel(topo, ici_cfg).seconds(info, payload_bytes)
     result = SweepResult(kind="collective", healthy=healthy, unit="s")
-    for a, b in topo.undirected_links():
-        view = link_down_schedule(topo, a, b).bind(topo).view_at(0.0)
-        model = CollectiveModel(topo.with_faults(view), ici_cfg)
-        secs = model.seconds(info, payload_bytes)
+    links = topo.undirected_links()
+    seconds = map_ordered(
+        _analytic_link_worker, links, workers=workers,
+        context=(topo, ici_cfg, info, payload_bytes),
+    )
+    for (a, b), secs in zip(links, seconds):
         result.rows.append(SweepRow(
             link=(topo.coords(a), topo.coords(b)),
             value=secs,
@@ -116,12 +139,29 @@ def single_link_sweep(
     return result
 
 
+def _trace_link_worker(link: tuple[int, int]) -> float:
+    """Replay the sweep trace with one link dead (pool worker).  Under
+    fork the shared result cache arrives pre-warmed by the baseline
+    replay, so only link-sensitive modules re-price."""
+    from tpusim.sim.driver import SimDriver
+
+    pod, cfg, topo, cache = pool_context()
+    a, b = link
+    rep = SimDriver(
+        cfg, topology=topo, faults=link_down_schedule(topo, a, b),
+        result_cache=cache,
+    ).run(pod)
+    return rep.cycles
+
+
 def trace_step_sweep(
     trace_path: str | Path,
     topo: Topology,
     arch: str | None = None,
     max_scenarios: int | None = 16,
     tuned: bool = True,
+    workers: int | None = None,
+    result_cache=None,
 ) -> SweepResult:
     """Replay ``trace_path`` once healthy, then once per dead-link
     scenario, reporting pod step-time (cycles) inflation.  Scenarios
@@ -130,7 +170,14 @@ def trace_step_sweep(
 
     The trace and config load ONCE; every replay (baseline included)
     runs on the same ``topo``, so the reported inflation isolates the
-    fault effect — nothing else varies between scenarios."""
+    fault effect — nothing else varies between scenarios.  One result
+    cache (``result_cache``: a :class:`tpusim.perf.ResultCache`, a disk
+    dir, or None for a fresh in-memory cache) is shared by ALL replays:
+    the baseline prices every module once, and per-link replays re-price
+    only the modules whose key includes the faulted topology (those with
+    collectives) — the healthy-kernel class is never re-priced (pinned
+    by tests/test_perf.py's engine-call-count regression)."""
+    from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.sim.driver import SimDriver
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
@@ -145,19 +192,21 @@ def trace_step_sweep(
 
             arch = detect_arch(kind).name
     cfg = load_config(arch=arch, tuned=tuned)
-    base = SimDriver(cfg, topology=topo).run(pod)
+    cache = as_result_cache(result_cache) or ResultCache()
+    base = SimDriver(cfg, topology=topo, result_cache=cache).run(pod)
     healthy = base.cycles
     result = SweepResult(kind="trace", healthy=healthy, unit="cycles")
     links = topo.undirected_links()
     if max_scenarios is not None:
         links = links[:max_scenarios]
-    for a, b in links:
-        rep = SimDriver(
-            cfg, topology=topo, faults=link_down_schedule(topo, a, b),
-        ).run(pod)
+    cycles = map_ordered(
+        _trace_link_worker, links, workers=workers,
+        context=(pod, cfg, topo, cache),
+    )
+    for (a, b), cyc in zip(links, cycles):
         result.rows.append(SweepRow(
             link=(topo.coords(a), topo.coords(b)),
-            value=rep.cycles,
-            inflation=rep.cycles / healthy if healthy > 0 else float("inf"),
+            value=cyc,
+            inflation=cyc / healthy if healthy > 0 else float("inf"),
         ))
     return result

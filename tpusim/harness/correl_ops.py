@@ -25,6 +25,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable
 
+from tpusim.perf.pool import map_ordered, pool_context
+
 __all__ = [
     "OpSilicon",
     "OpRow",
@@ -773,6 +775,22 @@ def silicon_from_artifact_rows(rows: list[dict]) -> dict[str, OpSilicon]:
     return out
 
 
+def _regen_price_worker(item: tuple) -> tuple:
+    """:mod:`tpusim.perf.pool` worker: price one fixture workload and
+    extract its XLA estimates (the expensive half of the offline regen;
+    correlation against the stored device rows stays in the parent).
+    The composed config rides the pool context — loaded once, not per
+    task."""
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    trace_rel, module_name = item
+    fixture_dir, cfg = pool_context()
+    td = load_trace(Path(fixture_dir) / trace_rel)
+    mod = select_module(td, module_name)
+    return Engine(cfg).run(mod), xla_op_estimates(mod)
+
+
 def regenerate_offline(
     artifact_path: str | Path,
     *,
@@ -780,6 +798,7 @@ def regenerate_offline(
     manifest_path: str | Path | None = None,
     arch: str = "v5e",
     out_path: str | Path | None = None,
+    workers: int | None = None,
 ) -> dict[str, Any]:
     """Re-correlate the CURRENT timing model against the device per-op
     durations stored in a previously captured ``correl_ops.json`` — pure
@@ -795,10 +814,13 @@ def regenerate_offline(
     Caveat, recorded in the output's ``provenance``: ops the capture-time
     model failed to match carry no stored duration, so the denominator of
     ``matched_time_fraction`` here is the previously-matched set (the
-    capture-time fraction per workload is carried forward alongside)."""
+    capture-time fraction per workload is carried forward alongside).
+
+    ``workers`` fans the per-workload engine replays over
+    :mod:`tpusim.perf.pool`; correlation and document assembly stay in
+    the parent in manifest order, so the emitted artifact is
+    byte-identical to a serial regen."""
     from tpusim.timing.config import load_config
-    from tpusim.timing.engine import Engine
-    from tpusim.trace.format import load_trace, select_module
 
     artifact_path = Path(artifact_path)
     old = json.loads(artifact_path.read_text())
@@ -809,10 +831,10 @@ def regenerate_offline(
     entries = {e["name"]: e for e in manifest.get("workloads", [])}
 
     cfg = load_config(arch=arch)
-    eng = Engine(cfg)
     corrs: list[OpCorrelation] = []
     capture_fractions: dict[str, Any] = {}
     dropped: list[str] = []
+    work: list[tuple] = []
     for w in old.get("workloads", []):
         name = w.get("workload")
         e = entries.get(name)
@@ -826,18 +848,25 @@ def regenerate_offline(
             )
             print(f"correl-regen: DROPPING {dropped[-1]}", file=sys.stderr)
             continue
-        td = load_trace(fixture_dir / e["trace"])
-        mod = select_module(td, e.get("module"))
-        res = eng.run(mod)
+        work.append((name, e, rows, w.get("matched_time_fraction")))
+    # the engine replays are the cost — fan them out; correlation below
+    # runs in the parent in manifest order (byte-identical artifact)
+    priced = map_ordered(
+        _regen_price_worker,
+        [(e["trace"], e.get("module")) for _, e, _, _ in work],
+        workers=workers,
+        context=(str(fixture_dir), cfg),
+    )
+    for (name, e, rows, fraction), (res, estimates) in zip(work, priced):
         silicon = silicon_from_artifact_rows(rows)
         corr = correlate_ops(
             res, silicon, clock_hz=cfg.arch.clock_hz, workload=name,
-            real_iters=1, xla_estimates=xla_op_estimates(mod),
+            real_iters=1, xla_estimates=estimates,
         )
         corr.counters = correlate_counters(
             res, silicon, clock_hz=cfg.arch.clock_hz, arch=cfg.arch,
         )
-        capture_fractions[name] = w.get("matched_time_fraction")
+        capture_fractions[name] = fraction
         corrs.append(corr)
 
     if not corrs:

@@ -190,14 +190,20 @@ def refine_arch_on_fixtures(
     from tpusim.harness.correl_ops import (
         correlate_ops, silicon_from_artifact_rows,
     )
+    from tpusim.perf.cache import CachedEngine, ResultCache
     from tpusim.timing.config import load_config
     from tpusim.timing.config import overlay as cfg_overlay
-    from tpusim.timing.engine import Engine
     from tpusim.trace.format import load_trace, select_module
 
     base_cfg = load_config(
         arch=arch_name, tuned=False, overlays=base_overlays or [],
     )
+    # coordinate descent revisits candidate vectors (neighbor probes
+    # across sweeps, the final re-score of the winner): one in-memory
+    # result cache across evals makes every repeat free without changing
+    # a single objective value (tpusim.perf; keys include the full
+    # composed config, so distinct candidates can never collide)
+    result_cache = ResultCache()
     mods = []
     skipped: list[str] = []
     for e in entries:
@@ -225,7 +231,7 @@ def refine_arch_on_fixtures(
             k: (round(v) if k in _INT_KNOBS else v) for k, v in vec.items()
         }
         cfg = cfg_overlay(base_cfg, {"arch": updates})
-        eng = Engine(cfg)
+        eng = CachedEngine(cfg, result_cache=result_cache)
         e2e, perop, asyn = [], [], []
         for e, mod in mods:
             try:

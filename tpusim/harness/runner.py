@@ -26,6 +26,7 @@ from typing import Any
 
 from tpusim.harness.procman import ProcMan
 from tpusim.harness.scrape import scrape_run_dirs, write_csv
+from tpusim.perf.pool import env_workers
 
 __all__ = ["RunSpec", "run_experiments", "run_suite", "overlay_to_flag_lines"]
 
@@ -40,6 +41,9 @@ class RunSpec:
     name: str | None = None
     power: bool = False
     obs: bool = False           # per-run obs exports under <run_dir>/obs/
+    #: shared engine-result cache dir for the simulate job (tpusim.perf);
+    #: repeat cells (re-runs, retries) skip their module pricing through it
+    result_cache: str | None = None
 
     @property
     def run_name(self) -> str:
@@ -121,9 +125,16 @@ def run_experiments(
     ``retries``: extra attempts per failed job (exponential backoff with
     jitter via :class:`~tpusim.harness.procman.ProcMan`); the default of
     one resubmission absorbs transient box flake without masking a
-    deterministic simulator failure for long."""
+    deterministic simulator failure for long.
+
+    ``parallel=None`` honors ``$TPUSIM_WORKERS`` before ProcMan's
+    half-the-cores default.  When the job matrix itself runs parallel,
+    every submitted simulate gets ``--workers 1``: the children inherit
+    the env var, and N matrix jobs each forking N pricing workers would
+    compound to N*N processes — the matrix IS the parallelism here."""
     out_root = Path(out_root)
-    pm = ProcMan(parallel=parallel)
+    pm = ProcMan(parallel=parallel if parallel is not None else env_workers())
+    matrix_parallel = (pm.parallel or 1) > 1
     for spec in specs:
         run_dir = _fabricate_run_dir(out_root, spec)
         cmd = [
@@ -138,6 +149,10 @@ def run_experiments(
             # per-run time series + prometheus text land beside the log,
             # scrapeable like the stats JSON
             cmd += ["--obs-out", str(run_dir / "obs")]
+        if spec.result_cache:
+            cmd += ["--result-cache", spec.result_cache]
+        if matrix_parallel:
+            cmd += ["--workers", "1"]
         pm.submit(
             cmd, log_path=run_dir / "run.log",
             retries=retries, backoff_s=backoff_s,
@@ -199,6 +214,7 @@ def run_suite(
     monitor_interval_s: float | None = 10.0,
     retries: int = 1,
     capture_retries: int = 2,
+    result_cache: str | Path | None = None,
 ) -> dict[str, dict[str, object]]:
     """The ``tpusim run -B suite -C v5p,v5e`` flow: resolve the suite,
     locate (or capture) each workload's trace, fabricate the suite×config
@@ -208,7 +224,10 @@ def run_suite(
     config from the YAML ``configs:`` section.  Capture jobs run against
     a live (flaky) backend and default to more resubmissions
     (``capture_retries``) than the deterministic simulate jobs
-    (``retries``)."""
+    (``retries``).  ``result_cache`` names a shared on-disk engine-result
+    cache dir every simulate cell mounts (``--result-cache``): repeat
+    cells — re-runs, retries after flake, unchanged (trace, config)
+    pairs across invocations — skip their module pricing entirely."""
     from tpusim.harness.suites import load_named_configs, load_suite
 
     out_root = Path(out_root)
@@ -273,6 +292,7 @@ def run_suite(
                 name=f"{e.run_name}__{extra}" if extra else e.run_name,
                 power=power,
                 obs=obs,
+                result_cache=str(result_cache) if result_cache else None,
             ))
     return run_experiments(
         specs, out_root, parallel=parallel, timeout_s=timeout_s,

@@ -1,0 +1,42 @@
+"""tpusim.perf — the performance layer: result caching + worker pools.
+
+Two independent levers over the same bottleneck (the schedule-walking
+engine re-pricing identical modules):
+
+* :mod:`tpusim.perf.cache` — a content-addressed
+  :class:`~tpusim.timing.engine.EngineResult` cache (in-memory LRU +
+  opt-in on-disk tier) keyed on what actually determines a module's
+  price: module content, composed config, arch, timing-model version,
+  degraded-chip multipliers, and — only for modules that touch the
+  ICI — the (possibly faulted) topology.
+* :mod:`tpusim.perf.pool` — a deterministic process pool (fork with
+  spawn fallback, ordered merge, serial short-circuit) that the fault
+  sweeps, the correlation regen, and the driver's segment pricing fan
+  out over.
+
+Both are strictly opt-in and bit-exact: a cached or parallel run
+reproduces the serial run's reports byte-for-byte (modulo the layer's
+own ``cache_*``/``pool_*`` accounting keys, which ride the stats report
+only when the feature is active — the ``faults_*`` discipline).
+"""
+
+from tpusim.perf.cache import (
+    CachedEngine,
+    DEFAULT_CACHE_DIR,
+    ResultCache,
+    as_result_cache,
+    config_fingerprint,
+    module_fingerprint,
+)
+from tpusim.perf.pool import map_ordered, resolve_workers
+
+__all__ = [
+    "CachedEngine",
+    "DEFAULT_CACHE_DIR",
+    "ResultCache",
+    "as_result_cache",
+    "config_fingerprint",
+    "module_fingerprint",
+    "map_ordered",
+    "resolve_workers",
+]

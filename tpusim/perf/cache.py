@@ -1,0 +1,506 @@
+"""Content-addressed :class:`EngineResult` cache.
+
+Accel-Sim caches parsed kernel traces per launch because trace-driven
+replay re-executes identical kernels thousands of times
+(``trace_driven.cc:540-586``); tpusim's equivalent hot loop is the
+schedule-walking engine re-pricing identical *modules* — a 64-link fault
+sweep replays the same healthy kernels once per scenario, a tuner run
+once per candidate config.  This module memoizes the priced result under
+a key built from everything that can change the price, and nothing else:
+
+    (module fingerprint, SimConfig fingerprint, arch name,
+     timing-model version, (clock_scale, hbm_scale) [, topology sig])
+
+The topology component is included **only for modules that contain
+collective ops** — a collective-free kernel prices identically on any
+pod, faulted or not, which is exactly why a link sweep can stop
+re-pricing the healthy-kernel class (the double-pricing fix in
+``tpusim.faults.sweep``).
+
+Tiers:
+
+* in-memory — an LRU dict (the per-process sweep/tuner win);
+* on-disk (opt-in, ``--result-cache[=DIR]``, default ``.tpusim_cache/``)
+  — JSON records with ``format_version``, written atomically
+  (temp + ``os.replace``), invalidated by construction on any
+  timing-model edit because :func:`~tpusim.timing.model_version.
+  model_version` is baked into the key (a bumped model simply never
+  matches the old files).  A corrupted/truncated record degrades to a
+  recompute with a warning, never an error.
+
+Determinism contract: a cache hit returns the exact float-for-float
+result the engine would have produced — serialization round-trips
+every counter through JSON's shortest-repr floats — so cached replays
+reproduce golden stats byte-for-byte.  Results that carry run-scoped
+state (obs samplers, recorded timelines) are never cached;
+:class:`CachedEngine` bypasses the cache entirely for those runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import warnings
+from collections import OrderedDict, defaultdict
+from pathlib import Path
+
+from tpusim.obs.hub import NULL_OBS
+from tpusim.timing.config import SimConfig
+from tpusim.timing.engine import Engine, EngineResult
+from tpusim.timing.model_version import model_version
+
+__all__ = [
+    "CACHE_FORMAT_VERSION",
+    "CachedEngine",
+    "DEFAULT_CACHE_DIR",
+    "ResultCache",
+    "as_result_cache",
+    "config_fingerprint",
+    "module_fingerprint",
+    "module_uses_ici",
+    "result_from_doc",
+    "result_to_doc",
+    "topology_signature",
+]
+
+CACHE_FORMAT_VERSION = 1
+
+#: the ``--result-cache`` flag's bare form resolves here (cwd-relative,
+#: like the reference's run-dir artifacts)
+DEFAULT_CACHE_DIR = ".tpusim_cache"
+
+_REPO = Path(__file__).resolve().parents[2]
+
+#: sources OUTSIDE the timing model that still determine how hashed
+#: module text prices: the IR and the parsers that build it (free-op
+#: sets, trip-count extraction, layout/shape decoding, the C++
+#: scanner).  model_version() deliberately covers only the timing
+#: sources (it stamps correlation artifacts); the cache must also
+#: invalidate on parser changes or a fixed parser would keep serving
+#: pre-fix numbers from old disk records.
+_PARSER_FILES: tuple[str, ...] = (
+    "tpusim/ir.py",
+    "tpusim/trace/hlo_text.py",
+    "tpusim/trace/native.py",
+    "tpusim/trace/lazy.py",
+    "tpusim/trace/loop_analysis.py",
+    "tpusim/trace/format.py",
+    "native/hlo_scan.cpp",
+)
+
+_parser_version_cache: str | None = None
+
+
+def parser_version() -> str:
+    """Digest of the IR/parser sources (computed once per process)."""
+    global _parser_version_cache
+    if _parser_version_cache is None:
+        h = hashlib.sha256()
+        for rel in _PARSER_FILES:
+            p = _REPO / rel
+            h.update(rel.encode())
+            h.update(b"\0")
+            h.update(p.read_bytes() if p.is_file() else b"")
+            h.update(b"\0")
+        _parser_version_cache = h.hexdigest()[:16]
+    return _parser_version_cache
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()[:24]
+
+
+def module_fingerprint(module) -> str | None:
+    """Content digest of one module.
+
+    ``load_trace`` stamps ``meta["content_hash"]`` from the on-disk HLO
+    text (the cheap, canonical source); modules built in memory fall
+    back to a structural walk over their ops.  Lazy modules hash their
+    raw text directly — fingerprinting must not force a full parse.
+    Returns None when no stable fingerprint exists (caching is then
+    skipped for that module, never wrong)."""
+    cached = getattr(module, "_fingerprint_cache", None)
+    if cached is not None:
+        return cached
+    fp = None
+    content = module.meta.get("content_hash") if module.meta else None
+    if content:
+        fp = str(content)
+    else:
+        text = getattr(module, "_text", None)  # LazyModuleTrace
+        if isinstance(text, str):
+            fp = _sha(text)
+        else:
+            try:
+                fp = _structural_fingerprint(module)
+            except (AttributeError, TypeError):
+                fp = None
+    try:
+        module._fingerprint_cache = fp
+    except (AttributeError, TypeError):
+        pass
+    return fp
+
+
+def _structural_fingerprint(module) -> str:
+    h = hashlib.sha256()
+    h.update(module.name.encode())
+    for cname in sorted(module.computations):
+        comp = module.computations[cname]
+        h.update(b"\0c")
+        h.update(cname.encode())
+        for op in comp.ops:
+            h.update(b"\0o")
+            h.update(
+                f"{op.name}|{op.opcode}|{op.result}|{op.operands}|"
+                f"{sorted(op.attrs.items()) if op.attrs else ''}".encode()
+            )
+    return h.hexdigest()[:24]
+
+
+#: collective base opcodes whose presence makes a module's price
+#: topology-dependent; used for the cheap raw-text scan on lazy modules
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+
+def module_uses_ici(module) -> bool:
+    """Does pricing this module consult the topology (any collective op)?
+
+    Conservative for lazy modules: a raw-text marker scan may over-match
+    (a comment mentioning ``all-reduce``), which only narrows cache
+    sharing — it can never produce a wrong hit."""
+    cached = getattr(module, "_uses_ici_cache", None)
+    if cached is not None:
+        return cached
+    text = getattr(module, "_text", None)
+    if isinstance(text, str):
+        uses = any(m in text for m in _COLLECTIVE_MARKERS)
+    else:
+        uses = any(op.is_collective for op in module.all_ops())
+    try:
+        module._uses_ici_cache = uses
+    except (AttributeError, TypeError):
+        pass
+    return uses
+
+
+def config_fingerprint(config: SimConfig) -> str:
+    """Digest of the fully-composed config (arch preset + tuned overlay
+    + explicit overlays all flattened — frozen dataclasses serialize
+    deterministically)."""
+    doc = dataclasses.asdict(config)
+    return _sha(json.dumps(doc, sort_keys=True, default=str))
+
+
+def topology_signature(topo) -> str | None:
+    """Stable signature of a (possibly faulted) topology, or None when
+    the attached fault view cannot be fingerprinted (caching skipped)."""
+    if topo is None:
+        return "none"
+    sig = f"{topo.dims}|{topo.wrap}"
+    faults = getattr(topo, "faults", None)
+    if faults is not None:
+        fsig = getattr(faults, "signature", None)
+        if fsig is None:
+            return None
+        sig += f"|f{fsig}"
+    return sig
+
+
+# ---------------------------------------------------------------------------
+# EngineResult (de)serialization
+# ---------------------------------------------------------------------------
+
+#: dict-valued counter fields restored as defaultdict(float)
+_FLOAT_MAP_FIELDS = (
+    "unit_busy_cycles", "opcode_cycles", "per_op_cycles", "per_op_count",
+    "per_op_hbm_bytes", "per_op_flops", "per_op_mxu_flops",
+)
+#: dict-valued fields restored as plain dicts
+_PLAIN_MAP_FIELDS = ("per_op_opcode", "per_op_async")
+#: run-scoped fields that are never cached
+_UNCACHED_FIELDS = ("timeline", "samples")
+
+
+def result_to_doc(result: EngineResult) -> dict:
+    """JSON-safe document for one result; every counter field of the
+    dataclass is carried explicitly so a future field addition changes
+    the document shape (and old records stop matching) instead of
+    silently dropping data."""
+    doc: dict = {}
+    for f in dataclasses.fields(EngineResult):
+        if f.name in _UNCACHED_FIELDS:
+            continue
+        value = getattr(result, f.name)
+        doc[f.name] = dict(value) if isinstance(value, dict) else value
+    return doc
+
+
+def result_from_doc(doc: dict) -> EngineResult:
+    expected = {
+        f.name for f in dataclasses.fields(EngineResult)
+        if f.name not in _UNCACHED_FIELDS
+    }
+    if set(doc) != expected:
+        raise ValueError(
+            f"cache record field mismatch: {sorted(set(doc) ^ expected)}"
+        )
+    result = EngineResult()
+    for name, value in doc.items():
+        if name in _FLOAT_MAP_FIELDS:
+            value = defaultdict(float, value)
+        elif name in _PLAIN_MAP_FIELDS:
+            value = dict(value)
+        setattr(result, name, value)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# The cache
+# ---------------------------------------------------------------------------
+
+
+class ResultCache:
+    """Two-tier content-addressed cache; see the module docstring.
+
+    One instance may be shared across many drivers/engines (the sweep's
+    per-link drivers all thread the same cache) — hit/miss counters are
+    therefore cumulative over the instance's lifetime."""
+
+    def __init__(
+        self,
+        disk_dir: str | Path | None = None,
+        max_entries: int = 1024,
+        obs=None,
+    ):
+        self.disk_dir = Path(disk_dir) if disk_dir else None
+        self.max_entries = max(int(max_entries), 1)
+        self.obs = obs if obs is not None else NULL_OBS
+        self._mem: OrderedDict[str, EngineResult] = OrderedDict()
+        # versions are captured once: a key is a statement about the
+        # code that computed the result, not about when it is read.
+        # model_version covers the timing sources; parser_version covers
+        # the IR/parsers that turn hashed text into the priced program.
+        self._model_version = f"{model_version()}+{parser_version()}"
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.disk_hits = 0
+        self.disk_errors = 0
+
+    # -- keys ----------------------------------------------------------------
+
+    def key_for(
+        self,
+        module,
+        config: SimConfig,
+        scales: tuple[float, float] = (1.0, 1.0),
+        topology=None,
+    ) -> str | None:
+        """The content-addressed key, or None when this (module, run)
+        cannot be cached safely."""
+        mfp = module_fingerprint(module)
+        if mfp is None:
+            return None
+        topo_part = "-"
+        if module_uses_ici(module):
+            topo = topology
+            if topo is None:
+                from tpusim.ici.topology import torus_for
+
+                topo = torus_for(module.num_devices, config.arch.name)
+            topo_part = topology_signature(topo)
+            if topo_part is None:
+                return None
+        # capture-time platform joins the key: the cost model normalizes
+        # capture-backend dtypes on module.meta["platform"], so identical
+        # HLO text captured on cpu vs tpu prices differently
+        platform = str(module.meta.get("platform", "")) if module.meta \
+            else ""
+        return "|".join((
+            mfp,
+            f"p={platform}",
+            config_fingerprint(config),
+            config.arch.name,
+            self._model_version,
+            f"{scales[0]!r},{scales[1]!r}",
+            topo_part,
+        ))
+
+    # -- lookup / insert -----------------------------------------------------
+
+    def get(self, key: str) -> EngineResult | None:
+        result = self._mem.get(key)
+        if result is not None:
+            self._mem.move_to_end(key)
+            self.hits += 1
+            self.obs.counter_add("cache.hits")
+            return result
+        if self.disk_dir is not None:
+            result = self._disk_get(key)
+            if result is not None:
+                self._mem_put(key, result)
+                self.hits += 1
+                self.disk_hits += 1
+                self.obs.counter_add("cache.hits")
+                self.obs.counter_add("cache.disk_hits")
+                return result
+        self.misses += 1
+        self.obs.counter_add("cache.misses")
+        return None
+
+    def put(self, key: str, result: EngineResult) -> None:
+        self._mem_put(key, result)
+        if self.disk_dir is not None:
+            self._disk_put(key, result)
+
+    def _mem_put(self, key: str, result: EngineResult) -> None:
+        self._mem[key] = result
+        self._mem.move_to_end(key)
+        while len(self._mem) > self.max_entries:
+            self._mem.popitem(last=False)
+            self.evictions += 1
+            self.obs.counter_add("cache.evictions")
+
+    # -- disk tier -----------------------------------------------------------
+
+    def _path_for(self, key: str) -> Path:
+        return self.disk_dir / f"{_sha(key)}.json"
+
+    def _disk_get(self, key: str) -> EngineResult | None:
+        path = self._path_for(key)
+        if not path.is_file():
+            return None
+        with self.obs.span("cache"):
+            try:
+                doc = json.loads(path.read_text())
+                if doc.get("format_version") != CACHE_FORMAT_VERSION:
+                    return None  # older layout: stale, not corrupt
+                if doc.get("key") != key:
+                    raise ValueError("stored key mismatch (hash collision?)")
+                if doc.get("model_version") != self._model_version:
+                    return None  # stale: model bumped under the same name
+                return result_from_doc(doc["result"])
+            except (ValueError, KeyError, TypeError, OSError) as e:
+                self.disk_errors += 1
+                self.obs.counter_add("cache.disk_errors")
+                warnings.warn(
+                    f"tpusim.perf: corrupt result-cache entry {path} "
+                    f"({type(e).__name__}: {e}); recomputing",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return None
+
+    def _disk_put(self, key: str, result: EngineResult) -> None:
+        with self.obs.span("cache"):
+            try:
+                self.disk_dir.mkdir(parents=True, exist_ok=True)
+                path = self._path_for(key)
+                doc = {
+                    "format_version": CACHE_FORMAT_VERSION,
+                    "model_version": self._model_version,
+                    "key": key,
+                    "result": result_to_doc(result),
+                }
+                tmp = path.with_suffix(f".{os.getpid()}.tmp")
+                tmp.write_text(json.dumps(doc))
+                os.replace(tmp, path)  # atomic: readers never see a torn file
+            except OSError as e:
+                self.disk_errors += 1
+                self.obs.counter_add("cache.disk_errors")
+                warnings.warn(
+                    f"tpusim.perf: result-cache write failed under "
+                    f"{self.disk_dir} ({e}); continuing uncached",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats_dict(self) -> dict[str, float]:
+        """Counter block the driver stamps under the ``cache_`` prefix
+        (only when a cache is active — the faults_* discipline)."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "disk_hits": self.disk_hits,
+            "disk_errors": self.disk_errors,
+            "entries": len(self._mem),
+        }
+
+
+def as_result_cache(spec, obs=None) -> ResultCache | None:
+    """Coerce the ``--result-cache`` flag family to a cache instance:
+    None/False → no cache; True → disk tier at :data:`DEFAULT_CACHE_DIR`;
+    a path → disk tier there; an existing :class:`ResultCache` passes
+    through (its obs hub is upgraded if it still has the no-op one)."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, ResultCache):
+        if obs is not None and spec.obs is NULL_OBS:
+            spec.obs = obs
+        return spec
+    if spec is True:
+        return ResultCache(disk_dir=DEFAULT_CACHE_DIR, obs=obs)
+    return ResultCache(disk_dir=spec, obs=obs)
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring
+# ---------------------------------------------------------------------------
+
+
+class CachedEngine(Engine):
+    """An :class:`Engine` whose ``run`` consults a :class:`ResultCache`.
+
+    The cache engages only for runs whose result is pure counters: obs
+    cycle-window sampling and timeline recording both attach run-scoped
+    objects, so those runs always price live.  A ``result_cache`` of
+    None makes this an exact Engine (one branch per module run)."""
+
+    def __init__(self, *args, result_cache: ResultCache | None = None, **kw):
+        super().__init__(*args, **kw)
+        self.result_cache = result_cache
+        # a caller-supplied cost model is outside the cache key (which
+        # fingerprints only the config + model sources), so such engines
+        # must never share results with the default-model population —
+        # bypass rather than silently cross-serve.  Engine's signature:
+        # (config, topology, cost_model, ...) — 3rd positional.
+        self._cache_eligible = (
+            kw.get("cost_model") is None and len(args) < 3
+        )
+
+    def run(self, module) -> EngineResult:
+        cache = self.result_cache
+        if (
+            cache is None
+            or not self._cache_eligible
+            or self.record_timeline
+            or (self.obs.enabled and self.obs.sample)
+        ):
+            return super().run(module)
+        key = cache.key_for(
+            module, self.config,
+            (self.clock_scale, self.hbm_scale),
+            self.topology,
+        )
+        if key is None:
+            return super().run(module)
+        cached = cache.get(key)
+        if cached is not None:
+            return cached
+        result = super().run(module)
+        cache.put(key, result)
+        return result

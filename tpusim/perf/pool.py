@@ -1,0 +1,140 @@
+"""Deterministic worker pool — the fan-out half of :mod:`tpusim.perf`.
+
+The reference parallelizes at the job level (``run_simulations.py``
+submits one process per benchmark×config cell); inside one simulation it
+is single-threaded.  tpusim's fan-out layers (link sweeps, correlation
+regen, the driver's per-segment module pricing) are embarrassingly
+parallel *and* pure — each task is a closed-form float computation — so
+a process pool with an **ordered** result merge reproduces the serial
+path bit-for-bit: same tasks, same math, same merge order.
+
+Contract:
+
+* ``workers<=1`` (the default when ``$TPUSIM_WORKERS`` is unset)
+  short-circuits to a plain in-process loop — no pool, no pickling, no
+  behavior change;
+* the start method is ``fork`` where available (context transfers by
+  inheritance — no pickling of pods/configs) with a ``spawn`` fallback
+  (context travels through the initializer, so it must pickle);
+* results always merge in task-submission order (``Pool.map``
+  semantics), so downstream reports cannot depend on scheduling;
+* any pool-infrastructure failure falls back to the serial loop rather
+  than failing the run — parallelism is an optimization, never a
+  requirement.
+
+Worker functions must be module-level (pickled by qualified name) and
+reach their shared inputs through :func:`pool_context`, set per call via
+``map_ordered(..., context=...)``.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import pickle
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "env_workers",
+    "map_ordered",
+    "pool_context",
+    "resolve_workers",
+]
+
+#: shared per-call inputs for worker functions; in the parent this is set
+#: by :func:`map_ordered` (the serial path uses it too, so workers are
+#: path-agnostic), in children by the pool initializer.
+_POOL_CONTEXT: Any = None
+
+
+def _init_worker(context: Any) -> None:
+    global _POOL_CONTEXT
+    _POOL_CONTEXT = context
+
+
+def pool_context() -> Any:
+    """The ``context=`` object of the in-flight :func:`map_ordered` call."""
+    return _POOL_CONTEXT
+
+
+def env_workers() -> int | None:
+    """``$TPUSIM_WORKERS`` as an int, or None when unset/garbage."""
+    raw = os.environ.get("TPUSIM_WORKERS", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return None
+
+
+def resolve_workers(workers: int | None) -> int:
+    """Effective worker count: the explicit request, else
+    ``$TPUSIM_WORKERS``, else 1 (serial — parallelism is opt-in).
+    Inside a pool worker this is always 1: daemonic processes cannot
+    fork children, so nested fan-out degrades to the serial path."""
+    if multiprocessing.current_process().daemon:
+        return 1
+    if workers is not None:
+        return max(int(workers), 1)
+    return env_workers() or 1
+
+
+def _serial(fn: Callable, items: list, context: Any) -> list:
+    # save/restore rather than reset: a nested serial map (e.g. a sweep
+    # worker whose driver falls back to serial) must not clobber the
+    # outer call's context for its remaining items
+    prev = _POOL_CONTEXT
+    _init_worker(context)
+    try:
+        return [fn(item) for item in items]
+    finally:
+        _init_worker(prev)
+
+
+def map_ordered(
+    fn: Callable,
+    items: Iterable,
+    workers: int | None = None,
+    context: Any = None,
+    chunksize: int = 1,
+) -> list:
+    """``[fn(item) for item in items]``, fanned over ``workers``
+    processes, results in input order.
+
+    ``fn`` must be a module-level function when ``workers > 1``;
+    ``context`` is exposed to it via :func:`pool_context` on every path
+    (serial included), so workers never branch on how they were run."""
+    items = list(items)
+    w = min(resolve_workers(workers), len(items))
+    if w <= 1:
+        return _serial(fn, items, context)
+    try:
+        # dispatchability probe: workers import fn by qualified name, so
+        # a closure/local fn can never run in a pool — take the serial
+        # path up front instead of interpreting a later AttributeError
+        # (which a TASK may legitimately raise) as dispatch failure
+        pickle.dumps(fn)
+    except Exception:
+        return _serial(fn, items, context)
+    methods = multiprocessing.get_all_start_methods()
+    ctx = multiprocessing.get_context(
+        "fork" if "fork" in methods else "spawn"
+    )
+    try:
+        pool = ctx.Pool(w, initializer=_init_worker, initargs=(context,))
+    except (OSError, ValueError, ImportError,
+            multiprocessing.ProcessError, pickle.PicklingError):
+        # pool INFRASTRUCTURE failed (fd limits, sandboxed fork,
+        # unpicklable context on spawn): degrade to the serial loop —
+        # same tasks, same order, same results
+        return _serial(fn, items, context)
+    try:
+        with pool:
+            return pool.map(fn, items, chunksize=chunksize)
+    except pickle.PicklingError:
+        # items failed to pickle — a dispatch problem (fn was probed
+        # above), not a task failure, so the serial loop still applies.
+        # Real task exceptions (OSError from a missing trace,
+        # AttributeError from a malformed op) propagate unchanged.
+        return _serial(fn, items, context)

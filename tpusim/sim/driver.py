@@ -27,11 +27,35 @@ from tpusim.ici.topology import Topology, torus_for
 from tpusim.ir import CommandKind, PodTrace, TraceCommand
 from tpusim.obs.hub import NULL_OBS
 from tpusim.obs.sampler import CycleWindowSampler
+from tpusim.perf.pool import map_ordered, pool_context, resolve_workers
 from tpusim.sim.stats import EXIT_SENTINEL, StatsRegistry
 from tpusim.timing.config import SimConfig
 from tpusim.timing.engine import Engine, EngineResult
 
 __all__ = ["SimDriver", "SimReport", "simulate_trace"]
+
+
+def _price_segment_worker(item):
+    """:mod:`tpusim.perf.pool` worker: price one ``(module, scales)``
+    launch class — the unit of the driver's segment-parallel replay.
+    Pure: same engine math as the serial path, so the returned counters
+    are bit-identical to an in-process run."""
+    name, scales = item
+    cfg, topo, modules, cache = pool_context()
+    if cache is not None:
+        from tpusim.perf.cache import CachedEngine
+
+        eng = CachedEngine(
+            cfg, topology=topo,
+            clock_scale=scales[0], hbm_scale=scales[1],
+            result_cache=cache,
+        )
+    else:
+        eng = Engine(
+            cfg, topology=topo,
+            clock_scale=scales[0], hbm_scale=scales[1],
+        )
+    return eng.run(modules[name])
 
 
 @dataclass
@@ -119,6 +143,8 @@ class SimDriver:
         topology: Topology | None = None,
         obs=None,
         faults=None,
+        result_cache=None,
+        workers: int | None = None,
     ):
         self.config = config
         self.arch = config.arch
@@ -129,6 +155,17 @@ class SimDriver:
         # fault schedule (tpusim.faults.FaultSchedule | path | dict);
         # None = healthy pod, zero added work and zero added stats keys
         self.faults = faults
+        # tpusim.perf: engine-result cache (ResultCache | dir path | True
+        # for the default disk dir) and worker count for segment-parallel
+        # pricing (None = $TPUSIM_WORKERS, else serial).  Both default
+        # off: the healthy serial path is unchanged, key-identical.
+        if result_cache is not None and result_cache is not False:
+            from tpusim.perf.cache import as_result_cache
+
+            self.result_cache = as_result_cache(result_cache, obs=self.obs)
+        else:
+            self.result_cache = None
+        self.workers = workers
 
     # ------------------------------------------------------------------
 
@@ -167,7 +204,19 @@ class SimDriver:
             else base_topo
         )
         coll = make_collective_model(topo, arch.ici, obs=obs)
-        engine = Engine(cfg, topology=topo, obs=obs)
+        if self.result_cache is not None:
+            from tpusim.perf.cache import CachedEngine
+
+            def _new_engine(**kw) -> Engine:
+                return CachedEngine(
+                    cfg, topology=topo, obs=obs,
+                    result_cache=self.result_cache, **kw,
+                )
+        else:
+            def _new_engine(**kw) -> Engine:
+                return Engine(cfg, topology=topo, obs=obs, **kw)
+
+        engine = _new_engine()
 
         # degraded chips run their own engine (straggler clock / HBM
         # throttle multipliers); the healthy class is the default engine
@@ -176,8 +225,7 @@ class SimDriver:
         def engine_for(scales: tuple[float, float]) -> Engine:
             e = engines.get(scales)
             if e is None:
-                e = engines[scales] = Engine(
-                    cfg, topology=topo, obs=obs,
+                e = engines[scales] = _new_engine(
                     clock_scale=scales[0], hbm_scale=scales[1],
                 )
             return e
@@ -259,6 +307,74 @@ class SimDriver:
         checkpoint_k = max(cfg.checkpoint_kernel, 0)
 
         window = max(cfg.kernel_window, 1)
+
+        # --- tpusim.perf: segment-parallel pricing ----------------------
+        # The replay decomposes into per-(module, chip-multiplier) launch
+        # classes whose pricing is pure and independent — the segments
+        # between stream barriers all draw from this class set.  With
+        # workers, the distinct classes price CONCURRENTLY up front; the
+        # stream walk below stays serial and consumes the pre-priced
+        # results, so every scalar accumulates in the exact serial order
+        # (bit-identical reports, pinned by tests/test_perf.py).  The
+        # parallel path disengages under obs (samplers are run-scoped),
+        # windowed faults (multipliers depend on issue cycle), and
+        # checkpoint/resume (classes past the barrier must not price).
+        workers = resolve_workers(self.workers)
+        pool_segments = 0
+        if (
+            workers > 1
+            and not obs.enabled
+            and not (fault_state is not None and fault_state.windowed)
+            and not resume_k and not checkpoint_k
+        ):
+            classes: list[tuple[str, tuple[float, float]]] = []
+            seen_classes: set[tuple[str, tuple[float, float]]] = set()
+            for dev_id in device_ids:
+                dev = pod.devices.get(dev_id)
+                if dev is None:
+                    continue
+                scales = (
+                    fault_view.chip_scales(dev_id)
+                    if fault_view is not None else (1.0, 1.0)
+                )
+                for cmd in dev.commands:
+                    if (
+                        cmd.kind == CommandKind.KERNEL_LAUNCH
+                        and cmd.module in pod.modules
+                        and (cmd.module, scales) not in seen_classes
+                    ):
+                        seen_classes.add((cmd.module, scales))
+                        classes.append((cmd.module, scales))
+            # classes the parent's cache already holds skip the pool
+            # entirely (a warm-cache run forks nothing and runs no
+            # engine anywhere)
+            remaining: list[tuple[str, tuple[float, float]]] = []
+            for mkey in classes if len(classes) > 1 else []:
+                res = None
+                if self.result_cache is not None:
+                    ck = self.result_cache.key_for(
+                        pod.modules[mkey[0]], cfg, mkey[1], topo
+                    )
+                    if ck is not None:
+                        res = self.result_cache.get(ck)
+                if res is not None:
+                    module_results[mkey] = res
+                else:
+                    remaining.append(mkey)
+            if len(remaining) > 1:
+                priced = map_ordered(
+                    _price_segment_worker, remaining, workers=workers,
+                    context=(cfg, topo, pod.modules, self.result_cache),
+                )
+                pool_segments = len(remaining)
+                for mkey, res in zip(remaining, priced):
+                    module_results[mkey] = res
+                    if self.result_cache is not None:
+                        ck = self.result_cache.key_for(
+                            pod.modules[mkey[0]], cfg, mkey[1], topo
+                        )
+                        if ck is not None:
+                            self.result_cache.put(ck, res)
 
         for dev_id in device_ids:
             dev = pod.devices.get(dev_id)
@@ -491,6 +607,18 @@ class SimDriver:
 
         report.wall_seconds = time.perf_counter() - t_start
         report.finalize(arch.clock_hz)
+        # perf-layer accounting rides the report ONLY when the feature is
+        # active (the faults_* discipline): serial/uncached runs stay
+        # key-identical, and byte-identity comparisons strip these keys.
+        if self.result_cache is not None:
+            report.stats.update(
+                self.result_cache.stats_dict(), prefix="cache_"
+            )
+        if pool_segments:
+            report.stats.update(
+                {"workers": workers, "parallel_segments": pool_segments},
+                prefix="pool_",
+            )
         if fault_state is not None:
             # faults_* keys ride the report ONLY when a schedule is
             # active — the healthy path stays key-identical to PR 1.
@@ -529,6 +657,8 @@ def simulate_trace(
     topology: Topology | None = None,
     lenient: bool = False,
     validate: str | bool | None = None,
+    result_cache=None,
+    workers: int | None = None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -545,7 +675,12 @@ def simulate_trace(
     config, and fault schedule run through ``tpusim.analysis`` first,
     and error-level diagnostics (plus warnings under ``"strict"``)
     raise :class:`tpusim.analysis.ValidationError` instead of pricing a
-    replay that would be silently wrong."""
+    replay that would be silently wrong.  ``result_cache`` (the
+    ``--result-cache[=DIR]`` flag: a :class:`tpusim.perf.ResultCache`,
+    a directory path, or True for the default dir) memoizes engine
+    results across runs; ``workers`` (``--workers`` /
+    ``$TPUSIM_WORKERS``) fans module pricing over a process pool — both
+    bit-identical to the serial path."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
@@ -583,5 +718,6 @@ def simulate_trace(
         cfg = load_config(config, arch=arch, overlays=overlays, tuned=tuned)
     with obs.span("simulate"):
         return SimDriver(
-            cfg, topology=topology, obs=obs, faults=faults
+            cfg, topology=topology, obs=obs, faults=faults,
+            result_cache=result_cache, workers=workers,
         ).run(pod)

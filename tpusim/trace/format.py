@@ -19,6 +19,7 @@ recorded-nothing gap, SURVEY.md §5).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import dataclass, field
@@ -281,6 +282,13 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
             # file name is the trace key; HloModule header name may differ
             pod.modules[key] = mod
             mod.meta.setdefault("trace_key", key)
+            # content digest of the module text — the address half of the
+            # tpusim.perf result cache's key (computed here, where the
+            # text is already in hand, so the cache never re-reads disk)
+            mod.meta.setdefault(
+                "content_hash",
+                hashlib.sha256(text.encode()).hexdigest()[:24],
+            )
             # capture-time facts (platform, device_kind) ride on every
             # module: the cost model gates capture-backend dtype
             # normalization on the platform the trace came from
