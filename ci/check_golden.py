@@ -14,6 +14,7 @@ Usage:
     python ci/check_golden.py --update        # regenerate goldens
     python ci/check_golden.py --obs-smoke     # obs-export schema smoke
     python ci/check_golden.py --faults-smoke  # degraded-pod schema smoke
+    python ci/check_golden.py --serve-smoke   # HTTP daemon determinism
 """
 
 from __future__ import annotations
@@ -400,6 +401,123 @@ def perf_smoke() -> dict:
     }
 
 
+def serve_smoke() -> dict:
+    """Serving-layer determinism contract (tpusim.serve):
+
+    1. a daemon booted on a free loopback port, serving the committed
+       fixture traces, must answer every golden-matrix request with a
+       stats doc BYTE-IDENTICAL to the committed CLI golden (same
+       JSON serialization, volatile + perf-accounting keys stripped);
+    2. a warm second pass over the same requests must serve every
+       response from the shared result cache: ``cache_hit`` true on
+       each and ZERO engine pricing walks anywhere in the process;
+    3. ``/metrics`` must parse as Prometheus text and carry the serve
+       counters; ``/healthz`` must be ok; the drain must complete.
+    Raises on violation."""
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+    from tpusim.timing.engine import Engine
+
+    runs = {"n": 0}
+    orig_run = Engine.run
+
+    def counting_run(self, module):
+        runs["n"] += 1
+        return orig_run(self, module)
+
+    def golden_bytes(name: str) -> str:
+        path = GOLDEN_DIR / f"{name}.json"
+        if not path.exists():
+            raise ValueError(f"no golden file {path} (run --update)")
+        return path.read_text()
+
+    def served_bytes(stats: dict) -> str:
+        doc = {
+            k: v for k, v in stats.items()
+            if k not in VOLATILE and not k.startswith(PERF_KEY_PREFIXES)
+        }
+        return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+    def run_pass(client) -> list[tuple[str, dict, bool]]:
+        out = []
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            r = client.simulate(
+                trace=fixture, arch=arch, overlays=list(overlays),
+                tuned=False,
+            )
+            out.append((name, r.stats, r.cache_hit))
+        return out
+
+    daemon = ServeDaemon(trace_root=FIXTURES, max_inflight=4)
+    daemon.start()
+    try:
+        client = ServeClient(daemon.url)
+        health = client.healthz()
+        if health.get("status") != "ok":
+            raise ValueError(f"healthz not ok: {health}")
+
+        cold = run_pass(client)
+        for name, stats, _hit in cold:
+            got = served_bytes(stats)
+            want = golden_bytes(name)
+            if got != want:
+                raise ValueError(
+                    f"served stats for {name} diverged from the "
+                    f"committed CLI golden (byte comparison failed)"
+                )
+
+        Engine.run = counting_run
+        try:
+            warm = run_pass(client)
+        finally:
+            Engine.run = orig_run
+        if runs["n"] != 0:
+            raise ValueError(
+                f"warm pass still executed {runs['n']} engine pricing "
+                f"walks (expected 0: every request must be served from "
+                f"the shared result cache)"
+            )
+        missed = [name for name, _s, hit in warm if not hit]
+        if missed:
+            raise ValueError(
+                f"warm pass responses did not report cache_hit: {missed}"
+            )
+        for (name, cold_stats, _h1), (_n2, warm_stats, _h2) in zip(
+            cold, warm
+        ):
+            if served_bytes(cold_stats) != served_bytes(warm_stats):
+                raise ValueError(
+                    f"warm served stats diverged from cold for {name}"
+                )
+
+        prom = client.metrics_text()
+        gauges = 0
+        for line in prom.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"bad prometheus line: {line!r}")
+            float(parts[1])
+            gauges += 1
+        for required in ("serve_requests_total", "serve_cache_hits"):
+            if f"tpusim_{required} " not in prom:
+                raise ValueError(f"/metrics missing {required}")
+    finally:
+        Engine.run = orig_run
+        if not daemon.drain_and_stop():
+            raise ValueError("daemon did not drain cleanly")
+    return {
+        "configs": len(cold),
+        "warm_cache_hits": len(warm),
+        "gauges": gauges,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -421,7 +539,26 @@ def main(argv: list[str] | None = None) -> int:
                          "committed serial goldens byte-for-byte, and a "
                          "warm-cache second pass must run zero engine "
                          "pricing walks")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    help="boot the serve daemon on a free port, replay "
+                         "the golden-matrix requests over HTTP: stats "
+                         "docs must be byte-identical to the committed "
+                         "CLI goldens, and a warm second pass must "
+                         "report cache_hit with zero engine walks")
     args = ap.parse_args(argv)
+
+    if args.serve_smoke:
+        try:
+            summary = serve_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --serve-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --serve-smoke: OK ({summary['configs']} "
+              f"served configs byte-identical to CLI goldens; warm pass "
+              f"{summary['warm_cache_hits']}/{summary['configs']} "
+              f"cache_hit with zero engine walks; "
+              f"{summary['gauges']} prometheus gauges)")
+        return 0
 
     if args.perf_smoke:
         try:

@@ -25,43 +25,52 @@
 #                --workers 4 + an on-disk result cache must match the
 #                committed serial goldens byte-for-byte, and a warm-
 #                cache pass must run zero engine pricing walks
-#   9. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#   9. serve   — serving-layer determinism: boot the daemon on a free
+#                loopback port, replay the golden matrix over HTTP;
+#                served stats docs must be byte-identical to the
+#                committed CLI goldens, and a warm second pass must
+#                report cache_hit on every response with zero engine
+#                pricing walks
+#  10. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-8
+# Usage:  bash ci/run_ci.sh            # tiers 1-9
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/9] build native ==="
+echo "=== [1/10] build native ==="
 make -C native
 
-echo "=== [2/9] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/10] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/9] unit tests (fast tier) ==="
+echo "=== [3/10] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/9] golden-stat regression sims ==="
+echo "=== [4/10] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/9] obs export smoke (schema-checked) ==="
+echo "=== [5/10] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/9] faults smoke (degraded-pod contract) ==="
+echo "=== [6/10] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/9] trace/config/schedule lint smoke ==="
+echo "=== [7/10] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/9] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/10] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
+echo "=== [9/10] serve smoke (HTTP daemon determinism) ==="
+python ci/check_golden.py --serve-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [9/9] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [10/10] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [9/9] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [10/10] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -88,6 +88,107 @@ def test_procman_backoff_grows_and_caps():
     assert j.next_backoff_s() == MAX_BACKOFF_S
 
 
+def test_procman_drain_finishes_running_cancels_pending(tmp_path):
+    """request_drain mid-run: the running child is reaped normally (its
+    work completes), never-started jobs go 'cancelled', and run()
+    returns instead of hanging on the frozen pending set."""
+    import threading
+    import time
+
+    pm = ProcMan(parallel=1)
+    marker = tmp_path / "slow.done"
+    j0 = pm.submit([
+        sys.executable, "-c",
+        f"import time, pathlib; time.sleep(0.4); "
+        f"pathlib.Path({str(marker)!r}).write_text('done')",
+    ])
+    j1 = pm.submit([sys.executable, "-c", "print('never runs')"])
+    results: list[bool] = []
+    th = threading.Thread(
+        target=lambda: results.append(pm.run(poll_s=0.02))
+    )
+    th.start()
+    deadline = time.time() + 10.0
+    while j0.status != "running":
+        assert time.time() < deadline, "job 0 never started"
+        time.sleep(0.01)
+    pm.request_drain()
+    th.join(timeout=30.0)
+    assert not th.is_alive()
+    assert j0.status == "done" and j0.returncode == 0
+    assert marker.exists()  # the in-flight child genuinely finished
+    assert j1.status == "cancelled"
+    assert results == [False]  # not all jobs succeeded (one cancelled)
+    summary = pm.status_summary()
+    assert summary == {"done": 1, "cancelled": 1}
+
+
+def test_procman_sigterm_drains_gracefully(tmp_path):
+    """run(drain_signals=True) under a real SIGTERM: the slow child is
+    never orphaned, the queue stops, and the parent exits cleanly (rc
+    0) — unlike the default disposition, which kills the parent
+    mid-reap and leaves the child running."""
+    import signal
+    import subprocess
+    import textwrap
+    import time
+
+    marker = tmp_path / "slow.done"
+    code = textwrap.dedent(f"""
+        import json, sys
+        from tpusim.harness.procman import ProcMan
+
+        pm = ProcMan(parallel=1)
+        pm.submit([sys.executable, "-c",
+                   "import time, pathlib; time.sleep(0.4); "
+                   "pathlib.Path({str(marker)!r}).write_text('done')"])
+        pm.submit([sys.executable, "-c", "print('never runs')"])
+        printed = [False]
+
+        def tick(p):
+            if not printed[0] and any(
+                j.status == "running" for j in p.jobs
+            ):
+                printed[0] = True
+                print("RUNNING", flush=True)
+
+        ok = pm.run(poll_s=0.02, on_tick=tick, drain_signals=True)
+        print("SUMMARY " + json.dumps(pm.status_summary()), flush=True)
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, text=True,
+        cwd=Path(__file__).resolve().parent.parent,
+        start_new_session=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "RUNNING"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30.0)
+        out = proc.stdout.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0, out  # graceful: run() returned, process exited
+    assert marker.exists()  # the running child finished its work
+    import json as _json
+
+    summary = _json.loads(out.split("SUMMARY ", 1)[1])
+    assert summary == {"done": 1, "cancelled": 1}
+    # no orphan child in the group
+    import os as _os
+
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            _os.killpg(proc.pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("orphan procman child outlived the parent")
+
+
 def test_procman_reports_failure(tmp_path):
     pm = ProcMan(parallel=2)
     pm.submit([sys.executable, "-c", "raise SystemExit(3)"],

@@ -247,6 +247,52 @@ def test_prometheus_text_format():
     assert not any("skip" in l for l in lines)
 
 
+def test_prometheus_text_hardened_for_service_use():
+    """The serving daemon exports request-derived values, so the
+    exposition document must survive hostile keys/labels: names
+    sanitized (leading digits guarded), label values escaped, HELP/TYPE
+    emitted, non-finite floats in the spellings scrapers accept."""
+    text = prometheus_text(
+        {"1starts_with_digit": 2.0, "ok": 1.5, "inf_v": float("inf"),
+         "ninf_v": float("-inf"), "nan_v": float("nan"),
+         "bool_skipped": True},
+        labels={"trace": 'evil"name\\with\nnewline', "bad key!": "v"},
+        help_text={"ok": "a help line\nwith newline"},
+    )
+    lines = text.splitlines()
+    # names: prefix keeps most keys safe; a digit straight after an
+    # empty prefix would still be guarded
+    assert any(l.startswith("tpusim_1starts_with_digit{") for l in lines)
+    bare = prometheus_text({"9lives": 1}, prefix="")
+    assert bare.splitlines()[-1].startswith("_9lives ")
+    # HELP/TYPE lines present, help newline escaped
+    assert "# HELP tpusim_ok a help line\\nwith newline" in lines
+    assert "# TYPE tpusim_ok gauge" in lines
+    # label values escaped per the exposition format; label names
+    # sanitized
+    ok_line = next(l for l in lines if l.startswith("tpusim_ok{"))
+    assert '\\"' in ok_line and "\\n" in ok_line and "\\\\" in ok_line
+    assert "bad_key_=" in ok_line
+    assert "\n" not in ok_line
+    # non-finite spellings
+    assert any(l.endswith(" +Inf") for l in lines)
+    assert any(l.endswith(" -Inf") for l in lines)
+    assert any(l.endswith(" NaN") for l in lines)
+    # bools stay excluded
+    assert "bool_skipped" not in text
+
+
+def test_prometheus_collided_names_keep_one_sample():
+    # two keys that sanitize onto the same metric name: exactly one
+    # TYPE line and ONE sample survives — duplicate series with the
+    # same labelset make the whole exposition document unscrapable
+    text = prometheus_text({"a b": 1.0, "a!b": 2.0})
+    lines = text.splitlines()
+    assert lines.count("# TYPE tpusim_a_b gauge") == 1
+    samples = [l for l in lines if l.startswith("tpusim_a_b ")]
+    assert samples == ["tpusim_a_b 1"]  # first key in sorted order wins
+
+
 # -- driver-level contract ---------------------------------------------------
 
 @pytest.fixture(scope="module")

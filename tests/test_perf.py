@@ -461,3 +461,77 @@ def test_perf_namespaces_registered():
     assert "pool_" in STATS_NAMESPACES
     assert "cache_" in DOCUMENTED_UPDATE_PREFIXES
     assert "pool_" in DOCUMENTED_UPDATE_PREFIXES
+
+
+def test_pool_sigterm_drains_in_flight_work(tmp_path):
+    """SIGTERM mid-map drains the in-flight tasks and reaps the pool's
+    children before the signal takes effect: every task's side effect
+    lands, the process still dies of SIGTERM, and no orphan worker
+    lingers in the process group.
+
+    Runs in a pristine subprocess (this suite has jax's thread pools
+    loaded; forking under them is the harness's flake, not the pool's)
+    started as a session leader so the orphan check can interrogate the
+    whole group afterwards."""
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    from tpusim.envutil import REPO_ROOT, cpu_mesh_env
+
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    code = textwrap.dedent(f"""
+        import pathlib, time
+        from tpusim.perf.pool import map_ordered
+
+        OUT = pathlib.Path({str(marker_dir)!r})
+
+        def slow(i):
+            time.sleep(0.4)
+            (OUT / f"task{{i}}.done").write_text(str(i))
+            return i
+
+        print("MAPPING", flush=True)
+        map_ordered(slow, [0, 1, 2, 3], workers=2)
+        # unreachable when a SIGTERM arrived mid-map: the deferred
+        # signal is re-delivered before results return to the caller
+        (OUT / "after_map").write_text("reached")
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, text=True,
+        env=cpu_mesh_env(1), cwd=REPO_ROOT,
+        start_new_session=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "MAPPING"
+        # let round 1 get in flight, then kill mid-map
+        deadline = time.time() + 10.0
+        while not list(marker_dir.glob("task*.done")):
+            assert time.time() < deadline, "no task ever completed"
+            time.sleep(0.02)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # died OF the SIGTERM (default disposition, re-delivered post-drain)
+    assert rc == -signal.SIGTERM, rc
+    # ... but only after the whole map drained
+    done = sorted(p.name for p in marker_dir.glob("task*.done"))
+    assert done == ["task0.done", "task1.done", "task2.done", "task3.done"]
+    assert not (marker_dir / "after_map").exists()
+    # and no orphan pool worker survives in the process group
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            os.killpg(proc.pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.killpg(proc.pid, signal.SIGKILL)
+        raise AssertionError("orphan pool workers outlived the parent")

@@ -386,6 +386,68 @@ def _cmd_faults(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Long-running simulation service (tpusim.serve): JSON API over
+    HTTP with hot traces, admission control, a process-wide shared
+    engine-result cache, and SIGTERM drain."""
+    from tpusim.serve.daemon import ServeDaemon
+
+    daemon = ServeDaemon(
+        trace_root=args.trace_root,
+        host=args.host,
+        port=args.port,
+        max_inflight=args.max_inflight,
+        queue_depth=args.queue_depth,
+        deadline_s=args.deadline_s,
+        max_request_bytes=args.max_request_bytes,
+        result_cache=args.result_cache,
+        workers=args.workers or 1,
+        job_workers=args.job_workers,
+        drain_grace_s=args.drain_grace_s,
+        verbose=args.verbose,
+    )
+    daemon.install_signal_handlers()
+    daemon.start()
+    # the bound port line is the startup contract: --port 0 asks the
+    # kernel for a free port, and wrappers (tests, serve-smoke, shell
+    # scripts) parse this line to find it
+    print(f"tpusim serve: listening on http://{daemon.host}:{daemon.port} "
+          f"(traces: {args.trace_root or 'inline only'}; "
+          f"max-inflight {args.max_inflight}, queue {args.queue_depth})",
+          flush=True)
+    daemon.wait_stopped()
+    print("tpusim serve: drained, exiting", flush=True)
+    return 0
+
+
+def _cmd_serve_bench(args: argparse.Namespace) -> int:
+    """Loadgen for the serving daemon: replay a fixture request mix at a
+    target concurrency, report p50/p95/p99 + throughput, and compare the
+    warm served path against the cold one-shot CLI."""
+    from tpusim.serve.bench import format_report, run_serve_bench
+
+    mix = None
+    if args.trace:
+        mix = [
+            {"trace": t, "arch": args.arch}
+            for t in args.trace
+        ]
+    doc = run_serve_bench(
+        url=args.url,
+        trace_root=args.trace_root,
+        concurrency=args.concurrency,
+        requests=args.requests,
+        mix=mix,
+        cli_baseline=not args.no_cli_baseline,
+    )
+    print(format_report(doc))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"report written to {args.json}")
+    return 1 if doc.get("errors") else 0
+
+
 def _cmd_lint(args: argparse.Namespace) -> int:
     """Static trace/config/schedule analyzer — the `tpusim lint` front
     end over :mod:`tpusim.analysis` (stable TLxxx codes, file:line
@@ -920,6 +982,78 @@ def main(argv: list[str] | None = None) -> int:
                           "sharing is always on, this adds the disk "
                           "tier)")
     pfa.set_defaults(fn=_cmd_faults)
+
+    psv = sub.add_parser(
+        "serve",
+        help="simulation-as-a-service daemon: JSON API (simulate/lint/"
+             "sweep/jobs/healthz/metrics) with hot traces, admission "
+             "control, shared result cache, SIGTERM drain",
+    )
+    psv.add_argument("--host", default="127.0.0.1")
+    psv.add_argument("--port", type=int, default=8642,
+                     help="listen port (0 = ask the kernel; the bound "
+                          "port is printed on startup)")
+    psv.add_argument("--trace-root", default=None, metavar="DIR",
+                     help="directory whose subdirectories are servable "
+                          "traces (requests name them; no other "
+                          "filesystem paths are reachable)")
+    psv.add_argument("--max-inflight", type=int, default=4,
+                     help="concurrent requests actually executing")
+    psv.add_argument("--queue-depth", type=int, default=16,
+                     help="requests allowed to wait for a slot before "
+                          "new arrivals get 429 + Retry-After")
+    psv.add_argument("--deadline-s", type=float, default=30.0,
+                     help="default per-request deadline (a queued "
+                          "request past it gets 504; requests may "
+                          "lower/raise it via deadline_ms, capped)")
+    psv.add_argument("--max-request-bytes", type=int,
+                     default=8 * 1024 * 1024,
+                     help="request-body cap; larger bodies get 413 "
+                          "before being read")
+    psv.add_argument("--result-cache", nargs="?", const=True, default=None,
+                     metavar="DIR",
+                     help="add the disk tier to the shared engine-result "
+                          "cache (default dir .tpusim_cache/); the "
+                          "in-memory tier is always on — sharing across "
+                          "requests is the service's point")
+    psv.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="per-request pricing workers (default 1: "
+                          "process pools and threaded serving don't mix "
+                          "unless you know your start method)")
+    psv.add_argument("--job-workers", type=int, default=1,
+                     help="threads draining the async job queue "
+                          "(/v1/sweep)")
+    psv.add_argument("--drain-grace-s", type=float, default=60.0,
+                     help="SIGTERM drain budget before giving up on "
+                          "in-flight work")
+    psv.add_argument("--verbose", action="store_true",
+                     help="per-request access log on stderr")
+    psv.set_defaults(fn=_cmd_serve)
+
+    psb = sub.add_parser(
+        "serve-bench",
+        help="loadgen for the serve daemon: fixture request mix at a "
+             "target concurrency -> p50/p95/p99 + throughput vs the "
+             "cold one-shot CLI",
+    )
+    psb.add_argument("--url", default=None,
+                     help="target an already-running daemon (default: "
+                          "boot one in-process on a free port)")
+    psb.add_argument("--trace-root", default=None, metavar="DIR",
+                     help="trace root for the self-booted daemon "
+                          "(default: the committed test fixtures)")
+    psb.add_argument("--concurrency", type=int, default=8)
+    psb.add_argument("--requests", type=int, default=64)
+    psb.add_argument("--trace", action="append", default=None,
+                     help="fixture trace name(s) for the mix (default: "
+                          "llama_tiny_tp2dp2 + matmul_512)")
+    psb.add_argument("--arch", default="v5p",
+                     help="arch for --trace mix entries")
+    psb.add_argument("--no-cli-baseline", action="store_true",
+                     help="skip the cold-CLI comparison run")
+    psb.add_argument("--json", default=None,
+                     help="also write the report document here")
+    psb.set_defaults(fn=_cmd_serve_bench)
 
     pli = sub.add_parser(
         "lint",

@@ -50,16 +50,25 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     ),
     # the performance layer (PR 4): result-cache effectiveness
     # (hits/misses/evictions + disk tier) — stamped by the driver only
-    # when a cache is active, mirrored as obs counters by tpusim.perf
+    # when a cache is active, mirrored as obs counters by tpusim.perf.
+    # tpusim.serve is licensed too: every request prices through a
+    # per-request view of the shared cache, and the response's
+    # `cache_hit` field is the serving layer's designed bridge to it
     "cache_": (
         "tpusim/perf/", "tpusim/sim/driver.py", "tpusim/__main__.py",
-        "bench.py", "ci/check_golden.py",
+        "tpusim/serve/", "bench.py", "ci/check_golden.py",
     ),
     # worker-pool accounting (worker count, parallel segments) — stamped
     # by the driver only when the pool actually engaged
     "pool_": (
         "tpusim/perf/", "tpusim/sim/driver.py", "tpusim/__main__.py",
         "ci/check_golden.py",
+    ),
+    # the serving layer (PR 5): daemon request/admission/job counters
+    # exported on /metrics (prometheus gauges, not report lines) —
+    # minted only by tpusim.serve and the CI serve smoke
+    "serve_": (
+        "tpusim/serve/", "ci/check_golden.py",
     ),
 }
 
@@ -98,6 +107,7 @@ AUDIT_GLOBS = (
     "tpusim/faults/*.py",
     "tpusim/ici/*.py",
     "tpusim/perf/*.py",
+    "tpusim/serve/*.py",
     "tpusim/timing/engine.py",
 )
 

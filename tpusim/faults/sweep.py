@@ -155,13 +155,15 @@ def _trace_link_worker(link: tuple[int, int]) -> float:
 
 
 def trace_step_sweep(
-    trace_path: str | Path,
+    trace_path: str | Path | None,
     topo: Topology,
     arch: str | None = None,
     max_scenarios: int | None = 16,
     tuned: bool = True,
     workers: int | None = None,
     result_cache=None,
+    pod=None,
+    config=None,
 ) -> SweepResult:
     """Replay ``trace_path`` once healthy, then once per dead-link
     scenario, reporting pod step-time (cycles) inflation.  Scenarios
@@ -176,22 +178,33 @@ def trace_step_sweep(
     the baseline prices every module once, and per-link replays re-price
     only the modules whose key includes the faulted topology (those with
     collectives) — the healthy-kernel class is never re-priced (pinned
-    by tests/test_perf.py's engine-call-count regression)."""
+    by tests/test_perf.py's engine-call-count regression).
+
+    ``pod`` short-circuits the trace load with an already-parsed
+    :class:`~tpusim.ir.PodTrace` — the serving daemon sweeps its hot
+    registry entries without touching disk.  ``config`` supplies an
+    already-composed :class:`SimConfig` (overlays included) instead of
+    the ``arch``/``tuned`` recomposition — without it, a caller's
+    overlays would silently not price."""
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.sim.driver import SimDriver
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
-    pod = load_trace(trace_path)
-    if arch is None:
-        # same default as simulate_trace: the arch the trace was
-        # captured on, via the named-preset route
-        kind = str(pod.meta.get("device_kind", ""))
-        if kind:
-            from tpusim.timing.arch import detect_arch
+    if pod is None:
+        pod = load_trace(trace_path)
+    if config is not None:
+        cfg = config
+    else:
+        if arch is None:
+            # same default as simulate_trace: the arch the trace was
+            # captured on, via the named-preset route
+            kind = str(pod.meta.get("device_kind", ""))
+            if kind:
+                from tpusim.timing.arch import detect_arch
 
-            arch = detect_arch(kind).name
-    cfg = load_config(arch=arch, tuned=tuned)
+                arch = detect_arch(kind).name
+        cfg = load_config(arch=arch, tuned=tuned)
     cache = as_result_cache(result_cache) or ResultCache()
     base = SimDriver(cfg, topology=topo, result_cache=cache).run(pod)
     healthy = base.cycles

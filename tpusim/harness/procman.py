@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
+import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -36,7 +38,7 @@ class Job:
     cwd: str | None = None
     log_path: str | None = None
     env: dict[str, str] | None = None
-    status: str = "pending"       # pending | running | done | failed
+    status: str = "pending"   # pending | running | done | failed | cancelled
     returncode: int | None = None
     started_at: float | None = None
     finished_at: float | None = None
@@ -73,6 +75,22 @@ class ProcMan:
     def __init__(self, parallel: int | None = None):
         self.parallel = parallel or max((os.cpu_count() or 2) // 2, 1)
         self.jobs: list[Job] = []
+        # graceful-shutdown latch: once set, no pending job starts;
+        # running jobs are reaped normally (the SIGTERM drain contract —
+        # a killed suite run must not orphan its simulate children)
+        self._draining = False
+
+    # -- graceful shutdown -------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def request_drain(self) -> None:
+        """Stop starting pending jobs; let running ones finish.  The
+        ``run`` loop then returns once the last running job is reaped,
+        with the never-started jobs marked ``cancelled``."""
+        self._draining = True
 
     def submit(
         self,
@@ -155,6 +173,10 @@ class ProcMan:
         for j in running:
             self._reap(j)
         running = [j for j in self.jobs if j.status == "running"]
+        if self._draining:
+            # drain mode: nothing new starts; work remains only while
+            # something is still running (pending jobs no longer count)
+            return bool(running)
         now = time.time()
         pending = [
             j for j in self.jobs
@@ -169,21 +191,48 @@ class ProcMan:
         poll_s: float = 0.2,
         timeout_s: float | None = None,
         on_tick=None,
+        drain_signals: bool = False,
     ) -> bool:
         """Run until all jobs finish.  Returns True if all succeeded.
         ``on_tick(self)`` is called once per poll — the job_status.py
-        monitoring hook."""
-        deadline = time.time() + timeout_s if timeout_s else None
-        while self.step():
+        monitoring hook.
+
+        ``drain_signals=True`` turns SIGTERM/SIGINT into a graceful
+        drain for the duration of this call: running children are
+        reaped normally (never orphaned), never-started jobs are marked
+        ``cancelled``, and ``run`` returns instead of the process dying
+        mid-reap.  Handlers are installed only from the main thread and
+        always restored."""
+        prev_handlers: dict[int, object] = {}
+        if drain_signals and (
+            threading.current_thread() is threading.main_thread()
+        ):
+            try:
+                for s in (signal.SIGTERM, signal.SIGINT):
+                    prev_handlers[s] = signal.signal(
+                        s, lambda signum, frame: self.request_drain()
+                    )
+            except (ValueError, OSError):  # pragma: no cover
+                prev_handlers = {}
+        try:
+            deadline = time.time() + timeout_s if timeout_s else None
+            while self.step():
+                if on_tick is not None:
+                    on_tick(self)
+                if deadline and time.time() > deadline:
+                    self.kill_all()
+                    return False
+                time.sleep(poll_s)
             if on_tick is not None:
                 on_tick(self)
-            if deadline and time.time() > deadline:
-                self.kill_all()
-                return False
-            time.sleep(poll_s)
-        if on_tick is not None:
-            on_tick(self)
-        return all(j.status == "done" for j in self.jobs)
+            if self._draining:
+                for j in self.jobs:
+                    if j.status == "pending":
+                        j.status = "cancelled"
+            return all(j.status == "done" for j in self.jobs)
+        finally:
+            for s, prev in prev_handlers.items():
+                signal.signal(s, prev)
 
     def kill_all(self) -> None:
         for j in self.jobs:

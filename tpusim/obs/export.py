@@ -23,7 +23,9 @@ from pathlib import Path
 __all__ = [
     "COUNTER_TRACKS",
     "counter_track_events",
+    "escape_prometheus_label_value",
     "pod_chrome_trace",
+    "prometheus_metric_name",
     "prometheus_text",
     "read_samples_jsonl",
     "validate_obs_dir",
@@ -228,19 +230,95 @@ def pod_chrome_trace(
 # ---------------------------------------------------------------------------
 
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
 
-def prometheus_text(values: dict, prefix: str = "tpusim_") -> str:
+def prometheus_metric_name(key: str, prefix: str = "tpusim_") -> str:
+    """A valid exposition-format metric name for an arbitrary stat key:
+    every disallowed character collapses to ``_`` and a leading digit
+    gets a guard (``[a-zA-Z_:]`` must start the name).  Stat keys were
+    controlled identifiers until the serving layer started exporting
+    request-derived values; names must now be safe for ANY key."""
+    name = _PROM_BAD.sub("_", prefix + str(key))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def escape_prometheus_label_value(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash, the
+    double quote, and newline are the three characters with meaning."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_number(v: float) -> str:
+    """Shortest-repr gauge value; non-finite floats use the exposition
+    spellings (``+Inf``/``-Inf``/``NaN``), not Python's."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return f"{f:.10g}"
+
+
+def prometheus_text(
+    values: dict,
+    prefix: str = "tpusim_",
+    labels: dict | None = None,
+    help_text: dict | None = None,
+) -> str:
     """Prometheus exposition format for every numeric stat/counter — the
-    pull-scrape slot the reference fills with YAML regexes over stdout."""
+    pull-scrape slot the reference fills with YAML regexes over stdout,
+    now hardened for the serving daemon's ``/metrics``:
+
+    * metric names are sanitized for *any* key (leading digits guarded,
+      disallowed characters collapsed); when two keys collide onto one
+      sanitized name, only the first (in sorted key order) is emitted —
+      duplicate series with one labelset invalidate the whole scrape,
+      which would take down the very endpoint this hardening protects;
+    * ``labels`` (applied to every sample) have their names sanitized
+      and their values escaped per the format (backslash, quote,
+      newline) — a hostile trace name cannot break the document;
+    * ``help_text`` maps *input keys* to ``# HELP`` lines (newlines and
+      backslashes escaped);
+    * non-finite floats render as ``+Inf``/``-Inf``/``NaN``, the only
+      spellings scrapers accept.
+
+    Bools and non-numeric values are skipped, as before."""
+    label_part = ""
+    if labels:
+        pairs = ",".join(
+            f'{_PROM_LABEL_BAD.sub("_", str(k))}='
+            f'"{escape_prometheus_label_value(v)}"'
+            for k, v in sorted(labels.items())
+        )
+        label_part = "{" + pairs + "}"
     lines: list[str] = []
+    emitted: set[str] = set()
     for k in sorted(values):
         v = values[k]
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             continue
-        name = _PROM_BAD.sub("_", prefix + str(k))
+        name = prometheus_metric_name(k, prefix)
+        if name in emitted:
+            continue  # a second sample of one series kills the scrape
+        emitted.add(name)
+        help_line = (help_text or {}).get(k)
+        if help_line:
+            escaped = (
+                str(help_line).replace("\\", "\\\\").replace("\n", "\\n")
+            )
+            lines.append(f"# HELP {name} {escaped}")
         lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {float(v):.10g}")
+        lines.append(f"{name}{label_part} {_prom_number(v)}")
     return "\n".join(lines) + "\n"
 
 

@@ -42,6 +42,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import threading
 import warnings
 from collections import OrderedDict, defaultdict
 from pathlib import Path
@@ -287,6 +288,10 @@ class ResultCache:
         self.max_entries = max(int(max_entries), 1)
         self.obs = obs if obs is not None else NULL_OBS
         self._mem: OrderedDict[str, EngineResult] = OrderedDict()
+        # the serving daemon shares one instance across request threads;
+        # the lock covers the LRU mutations (move_to_end racing an
+        # eviction would KeyError), not the disk tier (atomic writes)
+        self._lock = threading.Lock()
         # versions are captured once: a key is a statement about the
         # code that computed the result, not about when it is read.
         # model_version covers the timing sources; parser_version covers
@@ -340,10 +345,12 @@ class ResultCache:
     # -- lookup / insert -----------------------------------------------------
 
     def get(self, key: str) -> EngineResult | None:
-        result = self._mem.get(key)
+        with self._lock:
+            result = self._mem.get(key)
+            if result is not None:
+                self._mem.move_to_end(key)
+                self.hits += 1
         if result is not None:
-            self._mem.move_to_end(key)
-            self.hits += 1
             self.obs.counter_add("cache.hits")
             return result
         if self.disk_dir is not None:
@@ -365,11 +372,15 @@ class ResultCache:
             self._disk_put(key, result)
 
     def _mem_put(self, key: str, result: EngineResult) -> None:
-        self._mem[key] = result
-        self._mem.move_to_end(key)
-        while len(self._mem) > self.max_entries:
-            self._mem.popitem(last=False)
-            self.evictions += 1
+        evicted = 0
+        with self._lock:
+            self._mem[key] = result
+            self._mem.move_to_end(key)
+            while len(self._mem) > self.max_entries:
+                self._mem.popitem(last=False)
+                self.evictions += 1
+                evicted += 1
+        for _ in range(evicted):
             self.obs.counter_add("cache.evictions")
 
     # -- disk tier -----------------------------------------------------------
@@ -413,7 +424,12 @@ class ResultCache:
                     "key": key,
                     "result": result_to_doc(result),
                 }
-                tmp = path.with_suffix(f".{os.getpid()}.tmp")
+                # pid AND thread ident: two daemon request threads
+                # racing the same cold key must not share a tmp file
+                # (one would publish the other's half-written record)
+                tmp = path.with_suffix(
+                    f".{os.getpid()}.{threading.get_ident()}.tmp"
+                )
                 tmp.write_text(json.dumps(doc))
                 os.replace(tmp, path)  # atomic: readers never see a torn file
             except OSError as e:
@@ -425,6 +441,24 @@ class ResultCache:
                     RuntimeWarning,
                     stacklevel=2,
                 )
+
+    def flush(self) -> int:
+        """Ensure every in-memory entry has its disk record (no-op for
+        memory-only caches).  Normal operation writes through at ``put``
+        time; this heals records whose write failed transiently (disk
+        full, permission blip) — the serving daemon calls it on SIGTERM
+        drain so a restart warms from everything the process computed.
+        Returns the number of records written."""
+        if self.disk_dir is None:
+            return 0
+        with self._lock:
+            items = list(self._mem.items())
+        healed = 0
+        for key, result in items:
+            if not self._path_for(key).is_file():
+                self._disk_put(key, result)
+                healed += 1
+        return healed
 
     # -- reporting -----------------------------------------------------------
 

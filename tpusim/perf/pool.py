@@ -32,6 +32,8 @@ from __future__ import annotations
 import multiprocessing
 import os
 import pickle
+import signal
+import threading
 from typing import Any, Callable, Iterable
 
 __all__ = [
@@ -40,6 +42,49 @@ __all__ = [
     "pool_context",
     "resolve_workers",
 ]
+
+
+class _DeferSignals:
+    """Defer SIGTERM/SIGINT while a pooled map is in flight.
+
+    The default SIGTERM disposition kills the parent instantly —
+    skipping atexit, so the pool's daemonic children are ORPHANED
+    mid-task (they finish their item, then block forever on the dead
+    task queue).  While this guard is active the signal is only
+    recorded; on exit — after the pool context has reaped its workers —
+    the original disposition is restored and the signal re-delivered,
+    so the process still honors the kill, just *after* the in-flight
+    work has drained (and, for cached sweeps, landed in the disk tier).
+
+    Signal handlers can only be installed from the main thread; from
+    worker threads (the serving daemon's request threads) this is a
+    no-op and the process-level handlers keep their behavior."""
+
+    _SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __enter__(self) -> "_DeferSignals":
+        self._received: list[int] = []
+        self._prev: dict[int, object] = {}
+        self._active = (
+            threading.current_thread() is threading.main_thread()
+        )
+        if self._active:
+            try:
+                for s in self._SIGNALS:
+                    self._prev[s] = signal.signal(
+                        s, lambda signum, frame: self._received.append(signum)
+                    )
+            except (ValueError, OSError):  # pragma: no cover - exotic hosts
+                self._active = False
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._active:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            for signum in self._received:
+                os.kill(os.getpid(), signum)
+        return False
 
 #: shared per-call inputs for worker functions; in the parent this is set
 #: by :func:`map_ordered` (the serial path uses it too, so workers are
@@ -130,7 +175,10 @@ def map_ordered(
         # same tasks, same order, same results
         return _serial(fn, items, context)
     try:
-        with pool:
+        # SIGTERM/SIGINT during the map drain the in-flight tasks and
+        # reap the children before the signal takes effect (see
+        # _DeferSignals) — a killed sweep leaves no orphan workers
+        with _DeferSignals(), pool:
             return pool.map(fn, items, chunksize=chunksize)
     except pickle.PicklingError:
         # items failed to pickle — a dispatch problem (fn was probed

@@ -1,0 +1,54 @@
+"""tpusim.serve — simulation-as-a-service daemon (architecture slot L14).
+
+Every entry point before this layer was a one-shot CLI run: each
+``simulate``/``faults``/``lint`` invocation pays full process start,
+config compose, and trace load, and nothing shares the warm in-memory
+result cache across requests.  The daemon composes the pieces PRs 1–4
+built — Prometheus text export (:mod:`tpusim.obs.export`), the static
+pre-flight (:mod:`tpusim.analysis`), the content-addressed result cache
+(:mod:`tpusim.perf`) — behind a stdlib-only JSON-over-HTTP API:
+
+* ``POST /v1/simulate`` — trace ref or inline HLO text + config overlay
+  + optional fault schedule → the stats doc, priced through
+  :class:`tpusim.perf.CachedEngine` over one process-wide shared
+  :class:`~tpusim.perf.ResultCache` (repeat requests are O(lookup));
+* ``POST /v1/lint`` — the ``tpusim lint`` diagnostics as JSON;
+* ``POST /v1/sweep`` — async link-failure sweeps: returns a job id;
+* ``GET /v1/jobs/<id>`` — queued/running/done/failed + result;
+* ``GET /healthz`` / ``GET /metrics`` — liveness + Prometheus gauges.
+
+Four internal layers: a registry of pre-loaded trace dirs
+(:mod:`.registry`), an admission/queue layer with bounded concurrency,
+deadlines, and request-size caps (:mod:`.admission`), a worker layer
+that prices through the shared cache (:mod:`.worker`), and the HTTP +
+lifecycle layer with SIGTERM drain (:mod:`.daemon`).  ``python -m
+tpusim serve`` starts it; :mod:`.client` is the typed urllib client and
+``python -m tpusim serve-bench`` (:mod:`.bench`) the loadgen.
+"""
+
+from tpusim.serve.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    Draining,
+    JobTable,
+    Overloaded,
+)
+from tpusim.serve.client import ServeClient, ServeError
+from tpusim.serve.daemon import SERVE_FORMAT_VERSION, ServeDaemon
+from tpusim.serve.registry import TraceRegistry
+from tpusim.serve.worker import RequestError, ServeWorker
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceeded",
+    "Draining",
+    "JobTable",
+    "Overloaded",
+    "RequestError",
+    "SERVE_FORMAT_VERSION",
+    "ServeClient",
+    "ServeDaemon",
+    "ServeError",
+    "ServeWorker",
+    "TraceRegistry",
+]

@@ -1,0 +1,324 @@
+"""Admission control + async job table — the daemon's queueing layer.
+
+The reference's L8 orchestration batches offline jobs (procman's bounded
+``parallel``); an online service needs the same bound plus *backpressure
+semantics*: a request that cannot run soon must be told so cheaply (429
++ ``Retry-After``), a request that waited past its deadline must fail
+predictably (504), and an oversized body must be refused before it is
+read (413).  This module owns those decisions; the HTTP layer only maps
+the exceptions to status codes.
+
+Model: at most ``max_inflight`` requests execute concurrently; up to
+``queue_depth`` more may wait.  A waiter that is still queued at its
+deadline raises :class:`DeadlineExceeded`; a request arriving with the
+wait queue full raises :class:`Overloaded` (the 429, with a retry hint
+derived from the observed service rate); once the daemon starts
+draining, everything new raises :class:`Draining` (503) while in-flight
+work runs to completion — the SIGTERM contract.
+
+:class:`JobTable` is the async half (``POST /v1/sweep`` → job id →
+``GET /v1/jobs/<id>``): a bounded FIFO drained by daemon-owned worker
+threads, with terminal results retained for polling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExceeded",
+    "Draining",
+    "Job",
+    "JobTable",
+    "Overloaded",
+]
+
+
+class Overloaded(RuntimeError):
+    """Queue full — the 429 with a Retry-After hint."""
+
+    def __init__(self, retry_after_s: float):
+        self.retry_after_s = max(float(retry_after_s), 1.0)
+        super().__init__(
+            f"queue full; retry after {self.retry_after_s:.0f}s"
+        )
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed while it was queued — the 504."""
+
+
+class Draining(RuntimeError):
+    """The daemon is shutting down and admits nothing new — the 503."""
+
+
+class AdmissionController:
+    """Bounded inflight + bounded FIFO wait queue with deadlines."""
+
+    def __init__(self, max_inflight: int = 4, queue_depth: int = 16):
+        self.max_inflight = max(int(max_inflight), 1)
+        self.queue_depth = max(int(queue_depth), 0)
+        self._cond = threading.Condition()
+        self._inflight = 0
+        # FIFO of waiter tokens: a fresh arrival may bypass it only
+        # when it is empty, so a queued request can never be starved
+        # to its deadline by a steady stream of newcomers
+        self._queue: list[object] = []
+        self._draining = False
+        # observed service rate feeds the Retry-After hint
+        self._done = 0
+        self._busy_seconds = 0.0
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start_drain(self) -> None:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float | None = None) -> bool:
+        """Block until nothing is in flight or queued (the drain join).
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout_s if timeout_s else None
+        with self._cond:
+            while self._inflight > 0 or self._queue:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cond.wait(remaining if remaining is not None else 0.5)
+        return True
+
+    def _retry_after(self) -> float:
+        """Hint: how long until a queue slot plausibly frees — the mean
+        observed service time times the backlog ahead of a new arrival,
+        spread over the inflight lanes."""
+        mean_s = (
+            self._busy_seconds / self._done if self._done else 1.0
+        )
+        backlog = self._inflight + len(self._queue)
+        return max(mean_s * backlog / self.max_inflight, 1.0)
+
+    # -- the slot ------------------------------------------------------------
+
+    def admit(self, deadline: float | None = None) -> "_Slot":
+        """Claim an execution slot, waiting (bounded by ``deadline``, a
+        ``time.monotonic()`` instant) for one to free.  Use as a context
+        manager::
+
+            with admission.admit(deadline):
+                ... do the work ...
+
+        Raises :class:`Overloaded` / :class:`DeadlineExceeded` /
+        :class:`Draining` instead of admitting."""
+        with self._cond:
+            if self._draining:
+                raise Draining("server is draining")
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded("deadline expired before admission")
+            if self._inflight >= self.max_inflight or self._queue:
+                if len(self._queue) >= self.queue_depth:
+                    raise Overloaded(self._retry_after())
+                token = object()
+                self._queue.append(token)
+                try:
+                    # FIFO: proceed only at the head of the queue AND
+                    # with a free lane — a newcomer behind us cannot
+                    # overtake, because it queues whenever _queue is
+                    # non-empty
+                    while (
+                        self._queue[0] is not token
+                        or self._inflight >= self.max_inflight
+                    ):
+                        if self._draining:
+                            raise Draining("server is draining")
+                        remaining = None
+                        if deadline is not None:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                raise DeadlineExceeded(
+                                    "deadline expired while queued"
+                                )
+                        self._cond.wait(
+                            remaining if remaining is not None else 0.5
+                        )
+                finally:
+                    # success or abandonment (deadline/drain), the token
+                    # leaves the line so later waiters can advance
+                    self._queue.remove(token)
+                    self._cond.notify_all()
+            self._inflight += 1
+        return _Slot(self)
+
+    def _release(self, busy_s: float) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._done += 1
+            self._busy_seconds += busy_s
+            self._cond.notify_all()
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats_dict(self) -> dict[str, float]:
+        with self._cond:
+            return {
+                "inflight": self._inflight,
+                "queued": len(self._queue),
+                "max_inflight": self.max_inflight,
+                "queue_depth": self.queue_depth,
+                "completed": self._done,
+                "draining": int(self._draining),
+            }
+
+
+class _Slot:
+    """One admitted execution; releases on exit and feeds the service-
+    rate estimate."""
+
+    __slots__ = ("_adm", "_t0")
+
+    def __init__(self, adm: AdmissionController):
+        self._adm = adm
+        self._t0 = time.monotonic()
+
+    def __enter__(self) -> "_Slot":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._adm._release(time.monotonic() - self._t0)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Async jobs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Job:
+    """One async request (``POST /v1/sweep``)."""
+
+    job_id: str
+    kind: str
+    request: dict
+    status: str = "queued"        # queued | running | done | failed
+    result: dict | None = None
+    error: str | None = None
+    submitted_s: float = field(default_factory=time.monotonic)
+    finished_s: float | None = None
+
+    def to_doc(self) -> dict:
+        doc = {
+            "job_id": self.job_id,
+            "kind": self.kind,
+            "status": self.status,
+        }
+        if self.result is not None:
+            doc["result"] = self.result
+        if self.error is not None:
+            doc["error"] = self.error
+        return doc
+
+
+class JobTable:
+    """Bounded FIFO of async jobs + terminal-result retention.
+
+    The daemon owns the worker threads; the table only sequences.  A
+    full queue raises :class:`Overloaded` — the same backpressure story
+    as the sync path."""
+
+    def __init__(self, queue_depth: int = 16, keep: int = 256):
+        self.queue_depth = max(int(queue_depth), 1)
+        self.keep = max(int(keep), 1)
+        self._cond = threading.Condition()
+        self._queue: list[Job] = []
+        self._jobs: dict[str, Job] = {}
+        self._next_id = 0
+        self._draining = False
+
+    def submit(self, kind: str, request: dict) -> Job:
+        with self._cond:
+            if self._draining:
+                raise Draining("server is draining")
+            if len(self._queue) >= self.queue_depth:
+                raise Overloaded(float(len(self._queue)))
+            self._next_id += 1
+            job = Job(
+                job_id=f"job-{self._next_id:06d}", kind=kind,
+                request=request,
+            )
+            self._queue.append(job)
+            self._jobs[job.job_id] = job
+            self._trim()
+            self._cond.notify()
+        return job
+
+    def get(self, job_id: str) -> Job | None:
+        with self._cond:
+            return self._jobs.get(job_id)
+
+    def next_job(self, timeout_s: float = 0.5) -> Job | None:
+        """Pop the next queued job (worker loop); None on timeout."""
+        with self._cond:
+            if not self._queue:
+                self._cond.wait(timeout_s)
+            if not self._queue:
+                return None
+            job = self._queue.pop(0)
+            job.status = "running"
+            return job
+
+    def finish(self, job: Job, result: dict | None, error: str | None) -> None:
+        with self._cond:
+            job.status = "failed" if error is not None else "done"
+            job.result = result
+            job.error = error
+            job.finished_s = time.monotonic()
+            self._cond.notify_all()
+
+    def start_drain(self) -> None:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float | None = None) -> bool:
+        """Block until no job is queued or running (the drain join:
+        queued jobs still execute — an accepted job id must resolve)."""
+        deadline = time.monotonic() + timeout_s if timeout_s else None
+        with self._cond:
+            while any(
+                j.status in ("queued", "running")
+                for j in self._jobs.values()
+            ):
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cond.wait(remaining if remaining is not None else 0.5)
+        return True
+
+    def _trim(self) -> None:
+        # retain only the newest `keep` terminal jobs; queued/running
+        # entries are never dropped
+        terminal = [
+            jid for jid, j in self._jobs.items()
+            if j.status in ("done", "failed")
+        ]
+        while len(terminal) > self.keep:
+            self._jobs.pop(terminal.pop(0), None)
+
+    def stats_dict(self) -> dict[str, float]:
+        with self._cond:
+            counts = {"queued": 0, "running": 0, "done": 0, "failed": 0}
+            for j in self._jobs.values():
+                counts[j.status] = counts.get(j.status, 0) + 1
+            return {f"jobs_{k}": v for k, v in counts.items()}

@@ -1,0 +1,229 @@
+"""``tpusim serve-bench`` — the serving layer's measured headline.
+
+Replays a fixture request mix against a daemon at a target concurrency
+and reports p50/p95/p99 latency + throughput, next to the cost of the
+same work as a one-shot CLI invocation (full process start + config
+compose + trace load + pricing).  The subsystem's acceptance number:
+a **warm cached** ``POST /v1/simulate`` must be orders of magnitude
+faster than the cold CLI path, because the daemon pays parse/compose
+once and every repeat request is an engine-cache lookup.
+
+By default the bench boots its own daemon in-process on an ephemeral
+loopback port (the same composition ``python -m tpusim serve`` runs);
+``--url`` points it at an external one instead, in which case the CLI
+baseline is skipped (the fixture may not exist locally).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+__all__ = ["format_report", "run_serve_bench"]
+
+#: the default fixture mix: the multi-device llama fixture is the
+#: headline (ISSUE acceptance), the matmul rides along as a second
+#: launch class so the cache serves more than one shape
+DEFAULT_MIX = (
+    {"trace": "llama_tiny_tp2dp2", "arch": "v5p"},
+    {"trace": "matmul_512", "arch": "v5e"},
+)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(
+        int(round(q / 100.0 * len(sorted_vals) + 0.5)) - 1,
+        len(sorted_vals) - 1,
+    )
+    return sorted_vals[max(idx, 0)]
+
+
+def _cli_seconds(trace_path: Path, arch: str, runs: int = 2) -> float:
+    """Wall seconds of one cold ``python -m tpusim simulate`` process —
+    the best (minimum) of ``runs``, so the reported speedup is the
+    conservative one."""
+    best = float("inf")
+    for _ in range(max(runs, 1)):
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpusim", "simulate",
+             str(trace_path), "--arch", arch],
+            capture_output=True, text=True,
+        )
+        dt = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"CLI baseline failed rc={proc.returncode}: "
+                f"{proc.stderr.strip()[:500]}"
+            )
+        best = min(best, dt)
+    return best
+
+
+def run_serve_bench(
+    url: str | None = None,
+    trace_root: str | Path | None = None,
+    concurrency: int = 8,
+    requests: int = 64,
+    mix: list[dict] | None = None,
+    cli_baseline: bool = True,
+    cli_runs: int = 2,
+    deadline_s: float = 120.0,
+) -> dict:
+    """Run the loadgen; returns the report document.
+
+    The measured pass is **warm**: one untimed priming request per mix
+    entry runs first, so the reported latencies are the steady-state
+    service the daemon exists to provide (the cold numbers are the CLI
+    baseline's whole story)."""
+    from tpusim.serve.client import ServeClient
+
+    mix = [dict(m) for m in (mix or DEFAULT_MIX)]
+    daemon = None
+    if url is None:
+        from tpusim.serve.daemon import ServeDaemon
+
+        if trace_root is None:
+            trace_root = (
+                Path(__file__).resolve().parents[2]
+                / "tests" / "fixtures" / "traces"
+            )
+        daemon = ServeDaemon(
+            trace_root=trace_root,
+            max_inflight=max(int(concurrency), 1),
+            queue_depth=max(int(concurrency) * 4, 16),
+            deadline_s=deadline_s,
+        ).start()
+        url = daemon.url
+    client = ServeClient(url, timeout_s=deadline_s)
+
+    try:
+        # prime: first contact pays trace load + config compose + the
+        # cold pricing walk; everything measured after this is warm
+        warm_info = []
+        for m in mix:
+            t0 = time.perf_counter()
+            r = client.simulate(**m)
+            warm_info.append({
+                "request": m,
+                "cold_s": time.perf_counter() - t0,
+                "cache_hit": r.cache_hit,
+            })
+
+        n_total = max(int(requests), 1)
+        n_threads = max(int(concurrency), 1)
+        latencies: list[float] = []
+        hits = 0
+        errors: list[str] = []
+        lock = threading.Lock()
+        next_idx = [0]
+
+        def loop():
+            nonlocal hits
+            local_client = ServeClient(url, timeout_s=deadline_s)
+            while True:
+                with lock:
+                    i = next_idx[0]
+                    if i >= n_total:
+                        return
+                    next_idx[0] += 1
+                req = mix[i % len(mix)]
+                t0 = time.perf_counter()
+                try:
+                    r = local_client.simulate(**req)
+                except Exception as e:  # noqa: BLE001 - report, don't die
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+                    if r.cache_hit:
+                        hits += 1
+
+        threads = [
+            threading.Thread(target=loop, name=f"serve-bench-{i}")
+            for i in range(n_threads)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+
+        latencies.sort()
+        doc: dict = {
+            "url": url,
+            "concurrency": n_threads,
+            "requests": len(latencies),
+            "errors": errors[:10],
+            "wall_s": round(wall, 4),
+            "throughput_rps": round(len(latencies) / wall, 2) if wall else 0,
+            "cache_hit_fraction": (
+                round(hits / len(latencies), 4) if latencies else 0.0
+            ),
+            "latency_ms": {
+                "p50": round(_percentile(latencies, 50) * 1e3, 3),
+                "p95": round(_percentile(latencies, 95) * 1e3, 3),
+                "p99": round(_percentile(latencies, 99) * 1e3, 3),
+                "max": round(latencies[-1] * 1e3, 3) if latencies else 0.0,
+            },
+            "warmup": warm_info,
+        }
+
+        if cli_baseline and trace_root is not None:
+            head = mix[0]
+            trace_path = Path(trace_root) / str(head.get("trace", ""))
+            if trace_path.is_dir():
+                cli_s = _cli_seconds(
+                    trace_path, str(head.get("arch", "v5p")), runs=cli_runs,
+                )
+                p50_s = _percentile(latencies, 50)
+                doc["cli_baseline"] = {
+                    "trace": head.get("trace"),
+                    "cold_cli_s": round(cli_s, 4),
+                    "warm_p50_ms": doc["latency_ms"]["p50"],
+                    "speedup_p50": (
+                        round(cli_s / p50_s, 1) if p50_s > 0 else None
+                    ),
+                }
+        return doc
+    finally:
+        if daemon is not None:
+            daemon.drain_and_stop()
+
+
+def format_report(doc: dict) -> str:
+    lines = [
+        f"tpusim serve-bench: {doc['requests']} requests @ "
+        f"concurrency {doc['concurrency']} against {doc['url']}",
+        f"  throughput: {doc['throughput_rps']} req/s "
+        f"(wall {doc['wall_s']}s; cache-hit fraction "
+        f"{doc['cache_hit_fraction']:.0%})",
+        f"  latency: p50 {doc['latency_ms']['p50']}ms  "
+        f"p95 {doc['latency_ms']['p95']}ms  "
+        f"p99 {doc['latency_ms']['p99']}ms  "
+        f"max {doc['latency_ms']['max']}ms",
+    ]
+    for w in doc.get("warmup", []):
+        lines.append(
+            f"  cold first request {w['request'].get('trace')}: "
+            f"{w['cold_s'] * 1e3:.1f}ms"
+        )
+    cb = doc.get("cli_baseline")
+    if cb:
+        lines.append(
+            f"  cold CLI simulate ({cb['trace']}): "
+            f"{cb['cold_cli_s'] * 1e3:.0f}ms -> warm served p50 "
+            f"{cb['warm_p50_ms']}ms = {cb['speedup_p50']}x"
+        )
+    if doc.get("errors"):
+        lines.append(f"  ERRORS ({len(doc['errors'])}): {doc['errors']}")
+    return "\n".join(lines)

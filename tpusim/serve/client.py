@@ -1,0 +1,290 @@
+"""Typed client for the :mod:`tpusim.serve` API — stdlib only.
+
+The programmatic counterpart of the curl examples in the README: one
+method per route, JSON in/out, server errors surfaced as
+:class:`ServeError` carrying the status, the stable error code, and the
+diagnostics document when the server attached one (the 400 validation
+path).  Used by ``tpusim serve-bench``, the CI serve smoke, and
+``tests/test_serve.py`` — the client IS the contract test surface.
+
+Transport: one persistent keep-alive connection per (client, thread)
+over :mod:`http.client`, reconnecting transparently when the server
+closed it.  A warm request prices in ~1ms server-side; paying a fresh
+TCP handshake + connection teardown per call (urllib's behavior) would
+cost more than the service itself.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+
+__all__ = ["JobStatus", "LintReport", "ServeClient", "ServeError", "SimResult"]
+
+
+class ServeError(RuntimeError):
+    """A non-2xx response from the daemon."""
+
+    def __init__(
+        self, status: int, code: str, detail: str,
+        doc: dict | None = None, retry_after_s: float | None = None,
+    ):
+        self.status = int(status)
+        self.code = code
+        self.detail = detail
+        self.doc = doc or {}
+        self.retry_after_s = retry_after_s
+        super().__init__(f"HTTP {status} {code}: {detail}")
+
+    @property
+    def diagnostics(self) -> list[dict]:
+        """The TLxxx items of a validation refusal ([] otherwise)."""
+        return list(
+            (self.doc.get("diagnostics") or {}).get("diagnostics", [])
+        )
+
+
+@dataclass
+class SimResult:
+    """``POST /v1/simulate`` response."""
+
+    stats: dict
+    cache_hit: bool
+    trace: str
+    arch: str
+    num_devices: int
+    sim_cycles: float
+    model_version: str
+    format_version: int
+
+
+@dataclass
+class LintReport:
+    """``POST /v1/lint`` response."""
+
+    summary: str
+    errors: int
+    warnings: int
+    diagnostics: dict
+    model_version: str
+
+    @property
+    def codes(self) -> list[str]:
+        return sorted({
+            d["code"] for d in self.diagnostics.get("diagnostics", [])
+        })
+
+
+@dataclass
+class JobStatus:
+    """``GET /v1/jobs/<id>`` response."""
+
+    job_id: str
+    status: str        # queued | running | done | failed
+    result: dict | None = None
+    error: str | None = None
+    raw: dict = field(default_factory=dict)
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in ("done", "failed")
+
+
+class ServeClient:
+    """One daemon endpoint; every method is a single HTTP round trip."""
+
+    def __init__(self, base_url: str, timeout_s: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        parsed = urllib.parse.urlsplit(self.base_url)
+        if parsed.scheme != "http" or parsed.hostname is None:
+            raise ValueError(
+                f"base_url must be http://host:port, got {base_url!r}"
+            )
+        self._host = parsed.hostname
+        self._port = parsed.port or 80
+        self._local = threading.local()
+
+    # -- transport -----------------------------------------------------------
+
+    def _conn(self, fresh: bool = False) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None or fresh:
+            if conn is not None:
+                conn.close()
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self.timeout_s,
+            )
+            self._local.conn = conn
+        return conn
+
+    def _raw(self, method: str, path: str, body: dict | None = None):
+        data = None
+        headers = {"Accept": "application/json"}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        for attempt in (0, 1):
+            conn = self._conn(fresh=attempt > 0)
+            sent = False
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                payload = resp.read()
+                return resp, payload
+            except (http.client.HTTPException, ConnectionError,
+                    BrokenPipeError) as e:
+                # the server may close an idle keep-alive connection
+                # between calls; one reconnect covers that, a second
+                # failure is real.  A non-idempotent request that
+                # FINISHED SENDING is never replayed — the server may
+                # have executed it (a re-sent /v1/sweep would enqueue a
+                # second job) — so only send-stage failures and safe
+                # methods retry.
+                conn.close()
+                self._local.conn = None
+                retryable = method == "GET" or not sent
+                if attempt or not retryable:
+                    raise ServeError(
+                        0, "connection_failed",
+                        f"{type(e).__name__}: {e}",
+                    ) from None
+
+    def _request(
+        self, method: str, path: str, body: dict | None = None,
+    ) -> dict:
+        resp, payload = self._raw(method, path, body)
+        try:
+            doc = json.loads(payload or b"{}")
+        except (json.JSONDecodeError, ValueError):
+            doc = {}
+        if resp.status >= 400:
+            retry_after = resp.getheader("Retry-After")
+            raise ServeError(
+                resp.status,
+                str(doc.get("error", "http_error")),
+                str(doc.get("detail", resp.reason)),
+                doc=doc,
+                retry_after_s=float(retry_after) if retry_after else None,
+            )
+        return doc
+
+    # -- routes --------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        resp, payload = self._raw("GET", "/metrics")
+        if resp.status != 200:
+            raise ServeError(resp.status, "http_error", resp.reason)
+        return payload.decode()
+
+    def traces(self) -> list[str]:
+        return list(self._request("GET", "/v1/traces").get("traces", []))
+
+    def simulate(
+        self,
+        trace: str | None = None,
+        hlo_text: str | None = None,
+        arch: str | None = None,
+        overlays: list[dict] | None = None,
+        faults: dict | None = None,
+        tuned: bool = True,
+        num_devices: int = 1,
+        validate: bool = True,
+        deadline_ms: int | None = None,
+    ) -> SimResult:
+        body: dict = {"tuned": tuned, "validate": validate}
+        if trace is not None:
+            body["trace"] = trace
+        if hlo_text is not None:
+            body["hlo_text"] = hlo_text
+            body["num_devices"] = num_devices
+        if arch is not None:
+            body["arch"] = arch
+        if overlays:
+            body["overlays"] = overlays
+        if faults is not None:
+            body["faults"] = faults
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        doc = self._request("POST", "/v1/simulate", body)
+        return SimResult(
+            stats=doc["stats"],
+            cache_hit=bool(doc["cache_hit"]),
+            trace=str(doc["trace"]),
+            arch=str(doc["arch"]),
+            num_devices=int(doc["num_devices"]),
+            sim_cycles=float(doc["sim_cycles"]),
+            model_version=str(doc["model_version"]),
+            format_version=int(doc["format_version"]),
+        )
+
+    def lint(
+        self,
+        trace: str | None = None,
+        hlo_text: str | None = None,
+        arch: str | None = None,
+        overlays: list[dict] | None = None,
+        faults: dict | None = None,
+        num_devices: int = 1,
+    ) -> LintReport:
+        body: dict = {}
+        if trace is not None:
+            body["trace"] = trace
+        if hlo_text is not None:
+            body["hlo_text"] = hlo_text
+            body["num_devices"] = num_devices
+        if arch is not None:
+            body["arch"] = arch
+        if overlays:
+            body["overlays"] = overlays
+        if faults is not None:
+            body["faults"] = faults
+        doc = self._request("POST", "/v1/lint", body)
+        return LintReport(
+            summary=str(doc["summary"]),
+            errors=int(doc["errors"]),
+            warnings=int(doc["warnings"]),
+            diagnostics=dict(doc["diagnostics"]),
+            model_version=str(doc["model_version"]),
+        )
+
+    def sweep(self, **request) -> str:
+        """Submit an async sweep; returns the job id."""
+        doc = self._request("POST", "/v1/sweep", request)
+        return str(doc["job_id"])
+
+    def job(self, job_id: str) -> JobStatus:
+        doc = self._request("GET", f"/v1/jobs/{job_id}")
+        return JobStatus(
+            job_id=str(doc["job_id"]),
+            status=str(doc["status"]),
+            result=doc.get("result"),
+            error=doc.get("error"),
+            raw=doc,
+        )
+
+    def wait_job(
+        self, job_id: str, timeout_s: float = 120.0,
+        poll_s: float = 0.1,
+    ) -> JobStatus:
+        """Poll until the job is terminal; raises TimeoutError."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            status = self.job(job_id)
+            if status.terminal:
+                return status
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {status.status!r} after "
+                    f"{timeout_s:.0f}s"
+                )
+            time.sleep(poll_s)

@@ -1,0 +1,511 @@
+"""The HTTP + lifecycle layer of :mod:`tpusim.serve`.
+
+Stdlib only: :class:`http.server.ThreadingHTTPServer` accepts, one
+thread per connection; the admission layer (not the thread count)
+bounds real concurrency.  The handler does protocol work exclusively —
+route, size-cap, parse, map exceptions to status codes — and delegates
+every decision to the layers below:
+
+====================  ====  =====================================
+route                 verb  backing layer
+====================  ====  =====================================
+``/v1/simulate``      POST  admission → :meth:`ServeWorker.simulate`
+``/v1/lint``          POST  admission → :meth:`ServeWorker.lint`
+``/v1/sweep``         POST  :class:`JobTable` (async; returns job id)
+``/v1/jobs/<id>``     GET   :class:`JobTable`
+``/v1/traces``        GET   :class:`TraceRegistry`
+``/healthz``          GET   liveness (503 while draining)
+``/metrics``          GET   Prometheus via ``obs.export.prometheus_text``
+====================  ====  =====================================
+
+Status mapping: :class:`~tpusim.serve.worker.RequestError` carries its
+own status (400/404/422), :class:`Overloaded` → 429 + ``Retry-After``,
+:class:`DeadlineExceeded` → 504, :class:`Draining` → 503, an oversized
+body → 413 before it is read.  Every JSON response carries
+``format_version``, ``model_version``, and (simulate) ``cache_hit`` so
+clients can reason about staleness.
+
+Lifecycle (the SIGTERM contract): stop admitting, let in-flight
+requests and accepted jobs run to completion, flush the disk tier of
+the shared result cache, close the listener, exit 0.  ``/healthz``
+reports 503 from the first drain instant so load balancers stop
+routing before the listener disappears.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpusim.serve.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    Draining,
+    JobTable,
+    Overloaded,
+)
+from tpusim.serve.registry import TraceRegistry
+from tpusim.serve.worker import MAX_DEADLINE_S, RequestError, ServeWorker
+
+__all__ = ["SERVE_FORMAT_VERSION", "ServeDaemon"]
+
+#: bumped when the response document shape changes
+SERVE_FORMAT_VERSION = 1
+
+#: default request-body cap (inline HLO fits; a runaway upload does not)
+DEFAULT_MAX_REQUEST_BYTES = 8 * 1024 * 1024
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Protocol-only; all policy lives in the daemon's layers."""
+
+    #: set per-daemon via the dynamic subclass in ServeDaemon.start
+    daemon_obj: "ServeDaemon" = None
+    protocol_version = "HTTP/1.1"
+    # small JSON responses after sub-ms pricing: waiting out Nagle/
+    # delayed-ACK would dominate the latency the cache just earned
+    disable_nagle_algorithm = True
+    # per-connection socket READ timeout: a client that sends headers
+    # and then stalls (or an idle keep-alive) would otherwise pin a
+    # handler thread forever — body reads happen BEFORE admission, so
+    # no admission bound covers them.  http.server catches the timeout
+    # in handle_one_request and closes the connection; in-flight
+    # pricing is unaffected (no read is outstanding while we work).
+    timeout = 60.0
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+        d = self.daemon_obj
+        if d is not None and d.verbose:
+            super().log_message(fmt, *args)
+
+    def _send_json(
+        self, status: int, doc: dict, headers: dict | None = None,
+    ) -> None:
+        d = self.daemon_obj
+        body = json.dumps({
+            "format_version": SERVE_FORMAT_VERSION,
+            "model_version": d.worker.model_version,
+            **doc,
+        }, sort_keys=True).encode() + b"\n"
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; the work is done either way
+        d._count_status(status)
+
+    def _send_text(self, status: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        self.daemon_obj._count_status(status)
+
+    def _read_body(self) -> dict | None:
+        """Size-capped JSON body; sends the error response itself and
+        returns None on refusal."""
+        d = self.daemon_obj
+        try:
+            length = int(self.headers.get("Content-Length", "0") or "0")
+        except ValueError:
+            length = -1
+        if length < 0:
+            self._send_json(411, {
+                "error": "length_required",
+                "detail": "Content-Length is required",
+            })
+            return None
+        if length > d.max_request_bytes:
+            # refuse BEFORE reading; the unread body makes the
+            # connection unusable, so close it
+            self.close_connection = True
+            self._send_json(413, {
+                "error": "request_too_large",
+                "detail": (
+                    f"body is {length} bytes; this server caps requests "
+                    f"at {d.max_request_bytes}"
+                ),
+            }, headers={"Connection": "close"})
+            return None
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError as e:
+            self._send_json(400, {
+                "error": "bad_json", "detail": f"body is not JSON: {e}",
+            })
+            return None
+        if not isinstance(body, dict):
+            self._send_json(400, {
+                "error": "bad_json", "detail": "body must be a JSON object",
+            })
+            return None
+        return body
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib signature
+        d = self.daemon_obj
+        d._count("serve_requests_total")
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            if d.admission.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {
+                    "status": "ok",
+                    "uptime_s": round(time.monotonic() - d._clock0, 3),
+                    **{f"admission_{k}": v
+                       for k, v in d.admission.stats_dict().items()},
+                })
+        elif path == "/metrics":
+            d._count("serve_requests_metrics_total")
+            self._send_text(200, d.metrics_text(), "text/plain; version=0.0.4")
+        elif path == "/v1/traces":
+            self._send_json(200, {"traces": d.registry.names()})
+        elif path.startswith("/v1/jobs/"):
+            job = d.jobs.get(path.rsplit("/", 1)[1])
+            if job is None:
+                self._send_json(404, {
+                    "error": "unknown_job",
+                    "detail": f"no such job {path.rsplit('/', 1)[1]!r}",
+                })
+            else:
+                self._send_json(200, job.to_doc())
+        else:
+            self._send_json(404, {
+                "error": "unknown_route", "detail": f"no route {path!r}",
+            })
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib signature
+        d = self.daemon_obj
+        d._count("serve_requests_total")
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/v1/simulate":
+            d._count("serve_requests_simulate_total")
+            self._run_sync("simulate", d.worker.simulate)
+        elif path == "/v1/lint":
+            d._count("serve_requests_lint_total")
+            self._run_sync("lint", d.worker.lint)
+        elif path == "/v1/sweep":
+            d._count("serve_requests_sweep_total")
+            body = self._read_body()
+            if body is None:
+                return
+            try:
+                job = d.jobs.submit("sweep", body)
+            except Overloaded as e:
+                d._count("serve_rejected_429_total")
+                self._send_json(429, {
+                    "error": "overloaded",
+                    "detail": "job queue full; retry later",
+                }, headers={"Retry-After": int(e.retry_after_s)})
+                return
+            except Draining:
+                d._count("serve_draining_503_total")
+                self._send_json(503, {
+                    "error": "draining",
+                    "detail": "server is draining; not accepting jobs",
+                })
+                return
+            self._send_json(202, {
+                "job_id": job.job_id, "status": job.status,
+                "poll": f"/v1/jobs/{job.job_id}",
+            })
+        else:
+            self._send_json(404, {
+                "error": "unknown_route", "detail": f"no route {path!r}",
+            })
+
+    def _run_sync(self, endpoint: str, fn) -> None:
+        """Admission-gated execution of one synchronous endpoint."""
+        d = self.daemon_obj
+        body = self._read_body()
+        if body is None:
+            return
+        budget_s = d.deadline_s
+        if body.get("deadline_ms") is not None:
+            try:
+                budget_s = float(body["deadline_ms"]) / 1000.0
+            except (TypeError, ValueError):
+                self._send_json(400, {
+                    "error": "bad_request",
+                    "detail": "deadline_ms must be a number",
+                })
+                return
+        budget_s = min(max(budget_s, 0.0), MAX_DEADLINE_S)
+        deadline = time.monotonic() + budget_s
+        try:
+            with d.admission.admit(deadline):
+                if d.work_hook is not None:
+                    d.work_hook(endpoint, body)
+                if time.monotonic() >= deadline:
+                    raise DeadlineExceeded("deadline expired at admission")
+                result = fn(body)
+        except RequestError as e:
+            if e.status == 400:
+                d._count("serve_validation_400_total")
+            self._send_json(e.status, {
+                "error": e.code, "detail": e.detail, **e.extra,
+            })
+            return
+        except Overloaded as e:
+            d._count("serve_rejected_429_total")
+            self._send_json(429, {
+                "error": "overloaded",
+                "detail": (
+                    f"{d.admission.max_inflight} in flight and the wait "
+                    f"queue is full; retry later"
+                ),
+            }, headers={"Retry-After": int(e.retry_after_s)})
+            return
+        except DeadlineExceeded:
+            d._count("serve_deadline_504_total")
+            self._send_json(504, {
+                "error": "deadline_exceeded",
+                "detail": (
+                    f"request did not start within its "
+                    f"{budget_s:.3f}s deadline"
+                ),
+            })
+            return
+        except Draining:
+            d._count("serve_draining_503_total")
+            self._send_json(503, {
+                "error": "draining",
+                "detail": "server is draining; retry against a peer",
+            })
+            return
+        except Exception as e:  # noqa: BLE001 - the 500 boundary
+            d._count("serve_errors_total")
+            self._send_json(500, {
+                "error": "internal",
+                "detail": f"{type(e).__name__}: {e}",
+            })
+            return
+        self._send_json(200, result)
+
+
+class ServeDaemon:
+    """Composes the four layers and owns the listener + job threads."""
+
+    def __init__(
+        self,
+        trace_root=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_inflight: int = 4,
+        queue_depth: int = 16,
+        deadline_s: float = 30.0,
+        max_request_bytes: int = DEFAULT_MAX_REQUEST_BYTES,
+        result_cache=None,
+        cache_entries: int = 4096,
+        workers: int = 1,
+        job_workers: int = 1,
+        job_queue_depth: int = 16,
+        drain_grace_s: float = 60.0,
+        verbose: bool = False,
+        work_hook=None,
+    ):
+        from tpusim.perf.cache import ResultCache, as_result_cache
+
+        self.host = host
+        self._requested_port = int(port)
+        self.deadline_s = float(deadline_s)
+        self.max_request_bytes = int(max_request_bytes)
+        self.drain_grace_s = float(drain_grace_s)
+        self.verbose = bool(verbose)
+        self.work_hook = work_hook
+
+        # the process-wide shared result cache: always at least the
+        # in-memory tier (sharing across requests IS the service's
+        # reason to exist); --result-cache adds the disk tier
+        self.result_cache = as_result_cache(result_cache) or ResultCache(
+            max_entries=cache_entries
+        )
+        self.result_cache.max_entries = max(
+            self.result_cache.max_entries, int(cache_entries)
+        )
+        self.registry = TraceRegistry(trace_root)
+        self.worker = ServeWorker(
+            self.registry, result_cache=self.result_cache, workers=workers,
+        )
+        self.admission = AdmissionController(
+            max_inflight=max_inflight, queue_depth=queue_depth,
+        )
+        self.jobs = JobTable(queue_depth=job_queue_depth)
+
+        self._httpd: ThreadingHTTPServer | None = None
+        self._serve_thread: threading.Thread | None = None
+        self._job_threads: list[threading.Thread] = []
+        self._job_workers = max(int(job_workers), 1)
+        self._stop_jobs = threading.Event()
+        self._stopped = threading.Event()
+        self._counters: dict[str, float] = {}
+        self._counter_lock = threading.Lock()
+        self._clock0 = time.monotonic()
+
+    # -- counters ------------------------------------------------------------
+
+    def _count(self, key: str, delta: float = 1.0) -> None:
+        with self._counter_lock:
+            self._counters[key] = self._counters.get(key, 0.0) + delta
+
+    def _count_status(self, status: int) -> None:
+        bucket = (
+            "serve_responses_ok_total" if status < 400 else
+            "serve_responses_client_error_total" if status < 500 else
+            "serve_responses_server_error_total"
+        )
+        self._count(bucket)
+
+    def metrics_text(self) -> str:
+        """The ``/metrics`` document — every serve counter plus the
+        admission/job/registry/cache gauges, in Prometheus exposition
+        format via the hardened :func:`~tpusim.obs.export.
+        prometheus_text`."""
+        from tpusim.obs.export import prometheus_text
+
+        with self._counter_lock:
+            values = dict(self._counters)
+        values["serve_uptime_s"] = time.monotonic() - self._clock0
+        for k, v in self.admission.stats_dict().items():
+            values[f"serve_admission_{k}"] = v
+        for k, v in self.jobs.stats_dict().items():
+            values[f"serve_{k}"] = v
+        for k, v in self.registry.stats_dict().items():
+            values[f"serve_registry_{k}"] = v
+        for k, v in self.worker.stats_dict().items():
+            values[f"serve_{k}"] = v
+        return prometheus_text(
+            values,
+            help_text={
+                "serve_requests_total": "HTTP requests received",
+                "serve_uptime_s": "seconds since daemon start",
+            },
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return self._requested_port
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ServeDaemon":
+        """Bind the listener and start serving on background threads.
+        Returns self (so tests can ``ServeDaemon(...).start()``)."""
+        handler = type(
+            "BoundHandler", (_Handler,), {"daemon_obj": self},
+        )
+
+        class _Server(ThreadingHTTPServer):
+            # most clients (urllib included) open a fresh connection
+            # per request; the stdlib backlog of 5 overflows under any
+            # real concurrency and SYN retransmits (~1s) then dwarf the
+            # service time
+            request_queue_size = 128
+
+        self._httpd = _Server(
+            (self.host, self._requested_port), handler,
+        )
+        self._httpd.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="tpusim-serve-accept", daemon=True,
+        )
+        self._serve_thread.start()
+        for i in range(self._job_workers):
+            t = threading.Thread(
+                target=self._job_loop, name=f"tpusim-serve-job-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._job_threads.append(t)
+        return self
+
+    def _job_loop(self) -> None:
+        while True:
+            job = self.jobs.next_job(timeout_s=0.2)
+            if job is None:
+                if self._stop_jobs.is_set():
+                    return
+                continue
+            try:
+                result = self.worker.sweep(job.request)
+            except RequestError as e:
+                self.jobs.finish(job, None, f"{e.code}: {e.detail}")
+                self._count("serve_jobs_failed_total")
+            except Exception as e:  # noqa: BLE001 - job boundary
+                self.jobs.finish(job, None, f"{type(e).__name__}: {e}")
+                self._count("serve_jobs_failed_total")
+            else:
+                self.jobs.finish(job, result, None)
+                self._count("serve_jobs_done_total")
+
+    def drain_and_stop(self) -> bool:
+        """The SIGTERM sequence: stop admitting, finish in-flight work
+        and accepted jobs, flush the disk cache, close the listener.
+        Returns True when everything drained inside the grace period."""
+        self.admission.start_drain()
+        self.jobs.start_drain()
+        clean = self.admission.wait_idle(self.drain_grace_s)
+        clean = self.jobs.wait_idle(self.drain_grace_s) and clean
+        self._stop_jobs.set()
+        for t in self._job_threads:
+            t.join(timeout=2.0)
+        flushed = self.result_cache.flush()
+        if self.verbose and flushed:
+            print(f"tpusim serve: drain flushed {flushed} cache records")
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self._stopped.set()
+        return clean
+
+    def wait_stopped(self, timeout_s: float | None = None) -> bool:
+        return self._stopped.wait(timeout_s)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → drain on a helper thread (the handler runs
+        on the main thread, which may be blocked in ``wait_stopped``;
+        ``shutdown()`` must never be called from the accept loop)."""
+
+        def _on_signal(signum, frame):
+            threading.Thread(
+                target=self.drain_and_stop,
+                name="tpusim-serve-drain", daemon=True,
+            ).start()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    # -- context manager (tests) ---------------------------------------------
+
+    def __enter__(self) -> "ServeDaemon":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        if not self._stopped.is_set():
+            self.drain_and_stop()
+        return False

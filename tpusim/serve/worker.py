@@ -1,0 +1,363 @@
+"""Request execution — the daemon's worker layer.
+
+One :class:`ServeWorker` is shared by every HTTP thread and every async
+job thread.  It owns the two warm stores that make the service faster
+than a CLI run:
+
+* the **process-wide result cache** (:class:`tpusim.perf.ResultCache`,
+  optionally disk-backed via ``--result-cache``): every request prices
+  through :class:`~tpusim.perf.CachedEngine`, so a repeat or near-repeat
+  request (same modules, same composed config) is O(lookup) instead of
+  an engine walk.  Each request sees the shared store through a
+  :class:`_RequestCacheView` that counts hits/misses *per request* —
+  the source of the response's ``cache_hit`` field — while the shared
+  counters keep feeding ``/metrics``;
+* the **composed-config cache**: ``load_config`` reads preset + tuned
+  overlay files from disk; the composition is pure, so it is keyed by
+  ``(arch, overlays, tuned)`` and reused across requests.
+
+Validation contract (the 400 path): error-level :mod:`tpusim.analysis`
+diagnostics reject the request with the full TLxxx list instead of
+pricing garbage — trace passes come pre-computed from the registry,
+config passes run on the composed config, schedule passes on the fault
+schedule, exactly the ``simulate --validate`` set.
+
+Determinism contract: the pricing path is byte-identical to the CLI —
+same :class:`~tpusim.sim.driver.SimDriver`, same arch-from-meta
+defaulting, same fault binding — so a served stats doc reproduces a
+``python -m tpusim simulate`` run float for float (pinned by
+``tests/test_serve.py`` and ``ci/check_golden.py --serve-smoke``;
+the per-request view's ``cache_hits``/``cache_misses`` accounting keys
+are the one addition, same namespace any ``--result-cache`` CLI run
+stamps).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from tpusim.perf.cache import ResultCache
+from tpusim.timing.model_version import model_version
+
+__all__ = ["RequestError", "ServeWorker"]
+
+#: hard cap on request deadlines — a client cannot pin a slot forever
+MAX_DEADLINE_S = 600.0
+
+#: composed configs kept hot (each is a small frozen dataclass, but the
+#: key is request-controlled — a client stepping an overlay float must
+#: not grow the daemon without bound; mirrors MAX_INLINE_ENTRIES)
+MAX_CONFIG_ENTRIES = 128
+
+
+class RequestError(Exception):
+    """A request-level failure with an HTTP status and a stable code.
+
+    ``extra`` merges into the JSON error body (e.g. the diagnostics doc
+    on a validation refusal)."""
+
+    def __init__(
+        self, status: int, code: str, detail: str,
+        extra: dict | None = None,
+    ):
+        self.status = int(status)
+        self.code = code
+        self.detail = detail
+        self.extra = extra or {}
+        super().__init__(f"{status} {code}: {detail}")
+
+
+class _RequestCacheView(ResultCache):
+    """Per-request window onto the shared cache.
+
+    Delegates storage to the shared instance (every request reads and
+    feeds the same warm store) but counts hits/misses locally — the
+    response's ``cache_hit`` must describe *this* request, and the
+    shared cumulative counters cannot be read racelessly around a run.
+    The driver stamps this view's ``stats_dict`` under ``cache_*``, so
+    served reports carry per-request cache effectiveness."""
+
+    def __init__(self, shared: ResultCache):
+        super().__init__(disk_dir=None, max_entries=1)
+        self._shared = shared
+
+    def get(self, key):
+        result = self._shared.get(key)
+        if result is not None:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return result
+
+    def put(self, key, result) -> None:
+        self._shared.put(key, result)
+
+    def stats_dict(self) -> dict[str, float]:
+        return {"hits": self.hits, "misses": self.misses}
+
+
+def _pod_devices(pod) -> int:
+    """The driver's pod-size rule, mirrored exactly (fault schedules
+    must bind against the same torus the replay will use)."""
+    return max(
+        int(pod.meta.get("num_devices", 0) or 0),
+        max((m.num_devices for m in pod.modules.values()), default=1),
+        len(pod.devices) or 1,
+    )
+
+
+class ServeWorker:
+    """Executes simulate / lint / sweep requests over the warm stores."""
+
+    def __init__(
+        self,
+        registry,
+        result_cache: ResultCache | None = None,
+        workers: int = 1,
+    ):
+        self.registry = registry
+        self.result_cache = result_cache
+        self.workers = max(int(workers), 1)
+        self.model_version = model_version()
+        self._config_cache: dict[str, object] = {}
+        self._config_lock = threading.Lock()
+
+    # -- shared resolution ---------------------------------------------------
+
+    def _resolve_entry(self, req: dict):
+        """The request's pod: a named registry trace or inline HLO."""
+        from tpusim.serve.registry import UnknownTrace
+
+        trace = req.get("trace")
+        hlo_text = req.get("hlo_text")
+        if (trace is None) == (hlo_text is None):
+            raise RequestError(
+                400, "bad_request",
+                "exactly one of 'trace' (registry name) or 'hlo_text' "
+                "(inline HLO) is required",
+            )
+        if trace is not None:
+            try:
+                return self.registry.get(str(trace)), False
+            except UnknownTrace as e:
+                raise RequestError(404, "unknown_trace", str(e.args[0]))
+        try:
+            entry = self.registry.get_inline(
+                str(hlo_text), int(req.get("num_devices", 1) or 1)
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            raise RequestError(
+                400, "hlo_parse_error",
+                f"inline HLO did not parse: {type(e).__name__}: {e}",
+            )
+        return entry, True
+
+    def _config_for(self, pod, req: dict):
+        """The composed SimConfig, cached by (arch, overlays, tuned).
+
+        Request overlays are JSON dicts only — a service request must
+        never name files on the daemon's filesystem."""
+        from tpusim.timing.config import load_config
+
+        arch = req.get("arch")
+        overlays = req.get("overlays") or []
+        if not isinstance(overlays, list) or not all(
+            isinstance(o, dict) for o in overlays
+        ):
+            raise RequestError(
+                400, "bad_request",
+                "'overlays' must be a list of JSON objects "
+                "(flag files are not servable)",
+            )
+        tuned = bool(req.get("tuned", True))
+        if arch is None:
+            # the CLI's arch-from-capture defaulting, via the same
+            # named-preset route so the tuned overlay applies
+            kind = str(pod.meta.get("device_kind", "") or "")
+            if kind:
+                from tpusim.timing.arch import detect_arch
+
+                arch = detect_arch(kind).name
+        key = json.dumps(
+            {"arch": arch, "overlays": overlays, "tuned": tuned},
+            sort_keys=True,
+        )
+        with self._config_lock:
+            cfg = self._config_cache.get(key)
+        if cfg is None:
+            try:
+                cfg = load_config(
+                    arch=arch, overlays=list(overlays), tuned=tuned
+                )
+            except (KeyError, ValueError, FileNotFoundError) as e:
+                raise RequestError(
+                    400, "bad_config", f"config does not compose: {e}"
+                )
+            with self._config_lock:
+                cfg = self._config_cache.setdefault(key, cfg)
+                while len(self._config_cache) > MAX_CONFIG_ENTRIES:
+                    oldest = next(iter(self._config_cache))
+                    if oldest == key:
+                        break
+                    self._config_cache.pop(oldest)
+        return cfg
+
+    def _analyze(self, entry, inline: bool, cfg, req: dict):
+        """The per-request pre-flight: cached trace passes + fresh
+        config/schedule passes.  Returns the Diagnostics."""
+        from tpusim.analysis.config_passes import run_config_passes
+        from tpusim.analysis.diagnostics import Diagnostics
+
+        diags = Diagnostics()
+        if not inline:
+            diags.items.extend(
+                self.registry.trace_diagnostics(entry).items
+            )
+        run_config_passes(cfg, diags, trace_meta=entry.pod.meta)
+        faults = req.get("faults")
+        if faults is not None:
+            from tpusim.analysis.schedule_passes import run_schedule_passes
+            from tpusim.ici.topology import torus_for
+
+            topo = torus_for(_pod_devices(entry.pod), cfg.arch.name)
+            run_schedule_passes(faults, topo, diags)
+        return diags
+
+    @staticmethod
+    def _reject(diags) -> None:
+        raise RequestError(
+            400, "validation_failed",
+            f"static analysis refused the request: {diags.summary()}",
+            extra={
+                "codes": sorted(d.code for d in diags.errors),
+                "diagnostics": json.loads(diags.to_json()),
+            },
+        )
+
+    # -- endpoints -----------------------------------------------------------
+
+    def simulate(self, req: dict) -> dict:
+        """``POST /v1/simulate`` — price one pod replay."""
+        from tpusim.sim.driver import SimDriver
+
+        entry, inline = self._resolve_entry(req)
+        cfg = self._config_for(entry.pod, req)
+        if bool(req.get("validate", True)):
+            diags = self._analyze(entry, inline, cfg, req)
+            if diags.has_errors:
+                self._reject(diags)
+        faults = None
+        if req.get("faults") is not None:
+            from tpusim.faults import load_fault_schedule
+
+            try:
+                faults = load_fault_schedule(req["faults"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise RequestError(
+                    400, "bad_faults", f"fault schedule rejected: {e}"
+                )
+        view = (
+            _RequestCacheView(self.result_cache)
+            if self.result_cache is not None else None
+        )
+        from tpusim.faults import TopologyPartitionedError
+
+        try:
+            report = SimDriver(
+                cfg, faults=faults, result_cache=view,
+                workers=self.workers,
+            ).run(entry.pod)
+        except (ValueError, KeyError, TopologyPartitionedError) as e:
+            # a replay refusal (partitioned topology, unknown module) is
+            # the request's fault, not the server's
+            raise RequestError(
+                422, "replay_failed", f"{type(e).__name__}: {e}"
+            )
+        stats = json.loads(report.stats.to_json())
+        return {
+            "trace": entry.name,
+            "arch": cfg.arch.name,
+            "num_devices": report.num_devices,
+            "sim_cycles": report.cycles,
+            "cache_hit": bool(
+                view is not None and view.misses == 0 and view.hits > 0
+            ),
+            "stats": stats,
+        }
+
+    def lint(self, req: dict) -> dict:
+        """``POST /v1/lint`` — the analyzer's report, never a refusal
+        (lint findings are the payload, not an error)."""
+        entry, inline = self._resolve_entry(req)
+        cfg = self._config_for(entry.pod, req)
+        diags = self._analyze(entry, inline, cfg, req)
+        from tpusim.analysis.diagnostics import Severity
+
+        return {
+            "trace": entry.name,
+            "arch": cfg.arch.name,
+            "summary": diags.summary(),
+            "errors": diags.count(Severity.ERROR),
+            "warnings": diags.count(Severity.WARNING),
+            "trace_passes": "skipped (inline hlo)" if inline else "ran",
+            "diagnostics": json.loads(diags.to_json()),
+        }
+
+    def sweep(self, req: dict) -> dict:
+        """``POST /v1/sweep`` body → the sweep report (runs on a job
+        thread; the HTTP layer returns a job id immediately)."""
+        from tpusim.faults.sweep import single_link_sweep, trace_step_sweep
+        from tpusim.ici.topology import torus_for
+
+        if req.get("trace") is not None or req.get("hlo_text") is not None:
+            # trace sweeps replay a pod per scenario — a registry name
+            # or an inline module both resolve to one
+            entry, _ = self._resolve_entry(req)
+            cfg = self._config_for(entry.pod, req)
+            chips = int(req.get("chips") or _pod_devices(entry.pod))
+            topo = torus_for(chips, cfg.arch.name)
+            result = trace_step_sweep(
+                None, topo,
+                max_scenarios=int(req.get("max_scenarios", 16) or 16),
+                workers=self.workers,
+                result_cache=self.result_cache,
+                pod=entry.pod,
+                config=cfg,
+            )
+        else:
+            cfg = self._config_for_sweep(req)
+            chips = int(req.get("chips", 64) or 64)
+            topo = torus_for(chips, cfg.arch.name)
+            payload_mb = float(req.get("payload_mb", 64.0) or 64.0)
+            result = single_link_sweep(
+                topo, cfg.arch.ici,
+                payload_bytes=payload_mb * 1024 * 1024,
+                kind=str(req.get("kind", "all-reduce")),
+                workers=self.workers,
+            )
+        return result.to_doc()
+
+    def _config_for_sweep(self, req: dict):
+        """Analytic sweeps have no pod to default the arch from."""
+
+        class _NoPod:
+            meta: dict = {}
+            modules: dict = {}
+            devices: dict = {}
+
+        shim = _NoPod()
+        if req.get("arch") is None:
+            req = dict(req, arch="v5p")  # the faults CLI default
+        return self._config_for(shim, req)
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats_dict(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if self.result_cache is not None:
+            for k, v in self.result_cache.stats_dict().items():
+                out[f"cache_{k}"] = v
+        with self._config_lock:
+            out["configs_hot"] = len(self._config_cache)
+        return out

@@ -35,12 +35,24 @@ MODEL_FILES: tuple[str, ...] = (
 )
 
 
+#: per-root digest memo — the sources cannot change under a running
+#: process, and the serving daemon computes a version per request
+#: (every per-request cache view stamps one); eight file reads per
+#: request is measurable, one per process is free
+_version_cache: dict[str, str] = {}
+
+
 def model_version(repo_root: str | Path | None = None) -> str:
-    """Short, stable digest of the current timing model's sources.
+    """Short, stable digest of the current timing model's sources
+    (computed once per process per root).
 
     Missing files hash as empty (a deleted overlay still changes the
     digest relative to a tree that had one)."""
     root = Path(repo_root) if repo_root is not None else _REPO
+    key = str(root)
+    cached = _version_cache.get(key)
+    if cached is not None:
+        return cached
     h = hashlib.sha256()
     for rel in MODEL_FILES:
         p = root / rel
@@ -48,4 +60,4 @@ def model_version(repo_root: str | Path | None = None) -> str:
         h.update(b"\0")
         h.update(p.read_bytes() if p.is_file() else b"")
         h.update(b"\0")
-    return h.hexdigest()[:16]
+    return _version_cache.setdefault(key, h.hexdigest()[:16])
