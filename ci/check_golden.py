@@ -518,6 +518,112 @@ def serve_smoke() -> dict:
     }
 
 
+#: the campaign smoke: a fixed-seed 16-scenario Monte-Carlo campaign on
+#: the llama_tiny fixture whose report must be BYTE-identical to the
+#: committed golden.  Seed 3 was picked to exercise every outcome class:
+#: partitioned scenarios (correlated axis bundles on dim-2 axes), a
+#: spread of compound-fault inflations, and a non-null capacity answer
+#: selecting the smallest candidate slice.  tuned=False like every
+#: golden: the report must not shift when a live run refreshes the fit.
+CAMPAIGN_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+CAMPAIGN_SMOKE_GOLDEN = GOLDEN_DIR / "campaign_smoke.json"
+CAMPAIGN_SMOKE_SPEC = {
+    "name": "ci-campaign-smoke",
+    "seed": 3,
+    "scenarios": 16,
+    "arch": "v5p",
+    "chips": 8,
+    "tuned": False,
+    "faults": {
+        "count": {"dist": "uniform", "min": 0, "max": 3},
+        "kinds": {"link_down": 1.0, "link_degraded": 1.0,
+                  "chip_straggler": 0.5, "hbm_throttle": 0.5},
+        "scale": {"min": 0.4, "max": 0.9},
+    },
+    "correlated_groups": [
+        {"name": "cable-bundle-y", "prob": 0.06, "axis": 1},
+        {"name": "cable-bundle-z", "prob": 0.06, "axis": 2},
+    ],
+    "slo": {"step_time_ms": 0.55, "percentile": 90},
+    "candidate_slices": [{"arch": "v5p", "chips": 4},
+                         {"arch": "v5p", "chips": 16}],
+}
+
+
+def campaign_smoke(update: bool = False) -> dict:
+    """Campaign-layer determinism contract (tpusim.campaign):
+
+    1. the fixed-seed campaign's report document must be byte-identical
+       to the committed golden (regen with ``--campaign-smoke
+       --update`` after an intended model/report change);
+    2. the report must carry every contract piece: inflation
+       p50/p95/p99/max, a nonzero partition rate, per-scenario energy
+       deltas, and a capacity table with a non-null smallest meeting
+       slice (watts joined from power/model.py);
+    3. the healthy-path golden matrix must stay byte-identical as
+       always — a campaign run must not perturb healthy pricing.
+    Raises on violation."""
+    from tpusim.campaign import run_campaign
+
+    res = run_campaign(
+        CAMPAIGN_SMOKE_SPEC,
+        trace_path=FIXTURES / CAMPAIGN_SMOKE_FIXTURE,
+    )
+    got = json.dumps(res.doc, indent=1, sort_keys=True) + "\n"
+    if update:
+        GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+        CAMPAIGN_SMOKE_GOLDEN.write_text(got)
+    if not CAMPAIGN_SMOKE_GOLDEN.exists():
+        raise ValueError(
+            f"no campaign golden {CAMPAIGN_SMOKE_GOLDEN} "
+            f"(run --campaign-smoke --update)"
+        )
+    want = CAMPAIGN_SMOKE_GOLDEN.read_text()
+    if got != want:
+        raise ValueError(
+            "campaign smoke: fixed-seed report diverged from the "
+            "committed golden (byte comparison failed) — a timing-model "
+            "or campaign-report change must regen with "
+            "--campaign-smoke --update"
+        )
+
+    doc = res.doc
+    primary = doc["slices"][0]
+    for key in ("p50", "p95", "p99", "max"):
+        if not isinstance(primary["inflation"].get(key), float):
+            raise ValueError(f"campaign smoke: inflation.{key} missing")
+    if not any(s["partition_rate"] > 0 for s in doc["slices"]):
+        raise ValueError(
+            "campaign smoke: no slice saw a partitioned scenario "
+            "(the seed was chosen to produce them)"
+        )
+    cap = doc.get("capacity")
+    if not cap or cap.get("smallest_meeting_slice") is None:
+        raise ValueError("campaign smoke: capacity answer missing/null")
+    if not all(
+        isinstance(r.get("healthy_watts"), float) for r in cap["table"]
+    ):
+        raise ValueError(
+            "campaign smoke: capacity table rows missing watts"
+        )
+    stats = res.stats.stats_dict()
+    if stats["campaign_partitioned_total"] < 1:
+        raise ValueError("campaign smoke: campaign_partitioned_total=0")
+
+    errors = compare(run_matrix())
+    if errors:
+        raise ValueError(
+            "campaign smoke: healthy-path golden matrix diverged:\n  "
+            + "\n  ".join(errors)
+        )
+    return {
+        "scenarios": stats["campaign_scenarios_total"],
+        "partitioned": stats["campaign_partitioned_total"],
+        "capacity": cap["smallest_meeting_slice"],
+        "matrix_configs": len(MATRIX),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -545,7 +651,29 @@ def main(argv: list[str] | None = None) -> int:
                          "docs must be byte-identical to the committed "
                          "CLI goldens, and a warm second pass must "
                          "report cache_hit with zero engine walks")
+    ap.add_argument("--campaign-smoke", action="store_true",
+                    help="run the fixed-seed 16-scenario Monte-Carlo "
+                         "campaign on the llama_tiny fixture: the "
+                         "report must be byte-identical to the "
+                         "committed golden (partition rate, inflation "
+                         "percentiles, capacity table included) and "
+                         "the healthy golden matrix must be untouched")
     args = ap.parse_args(argv)
+
+    if args.campaign_smoke:
+        try:
+            summary = campaign_smoke(update=args.update)
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --campaign-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --campaign-smoke: OK "
+              f"({summary['scenarios']:.0f} scenarios byte-identical "
+              f"to the committed report, "
+              f"{summary['partitioned']:.0f} partitioned outcomes, "
+              f"capacity answer {summary['capacity']!r}, healthy "
+              f"matrix unchanged across {summary['matrix_configs']} "
+              f"configs)")
+        return 0
 
     if args.serve_smoke:
         try:

@@ -31,46 +31,55 @@
 #                committed CLI goldens, and a warm second pass must
 #                report cache_hit on every response with zero engine
 #                pricing walks
-#  10. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  10. campaign — campaign-layer determinism: a fixed-seed 16-scenario
+#                Monte-Carlo compound-fault campaign on the llama_tiny
+#                fixture must reproduce the committed report
+#                byte-for-byte (inflation percentiles, partition rate,
+#                SLO capacity table), with the healthy golden matrix
+#                untouched
+#  11. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-9
+# Usage:  bash ci/run_ci.sh            # tiers 1-10
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/10] build native ==="
+echo "=== [1/11] build native ==="
 make -C native
 
-echo "=== [2/10] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/11] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/10] unit tests (fast tier) ==="
+echo "=== [3/11] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/10] golden-stat regression sims ==="
+echo "=== [4/11] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/10] obs export smoke (schema-checked) ==="
+echo "=== [5/11] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/10] faults smoke (degraded-pod contract) ==="
+echo "=== [6/11] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/10] trace/config/schedule lint smoke ==="
+echo "=== [7/11] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/10] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/11] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/10] serve smoke (HTTP daemon determinism) ==="
+echo "=== [9/11] serve smoke (HTTP daemon determinism) ==="
 python ci/check_golden.py --serve-smoke
 
+echo "=== [10/11] campaign smoke (Monte-Carlo determinism) ==="
+python ci/check_golden.py --campaign-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [10/10] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [11/11] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [10/10] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [11/11] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -1,0 +1,490 @@
+"""tpusim.campaign — Monte-Carlo compound-fault campaigns.
+
+The ISSUE-6 acceptance surface: byte-reproducible fixed-seed reports,
+crash-safe resume (SIGKILL mid-campaign → --resume re-prices zero
+completed scenarios), partitioned topologies landing as outcome rows,
+the SLO capacity table joining watts, campaign-spec validation codes,
+journal torn-write tolerance, JobTable disk persistence, and the
+``POST /v1/campaign`` daemon-restart resume path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpusim.campaign import (
+    CampaignSpecError,
+    Journal,
+    JournalError,
+    load_campaign_spec,
+    percentile,
+    run_campaign,
+    sample_schedule_doc,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+TRACE = FIXTURES / "llama_tiny_tp2dp2"
+
+
+def base_spec(**over) -> dict:
+    doc = {
+        "name": "t", "seed": 11, "scenarios": 4,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "faults": {
+            "count": {"dist": "uniform", "min": 0, "max": 2},
+            "kinds": {"link_down": 1.0, "link_degraded": 1.0,
+                      "chip_straggler": 0.5, "hbm_throttle": 0.5},
+            "scale": {"min": 0.4, "max": 0.9},
+        },
+    }
+    doc.update(over)
+    return doc
+
+
+# -- spec validation --------------------------------------------------------
+
+def test_spec_parses_and_defaults():
+    spec = load_campaign_spec(base_spec())
+    assert spec.seed == 11
+    assert spec.scenarios == 4
+    assert spec.faults.count.dist == "uniform"
+    assert dict(spec.faults.kinds)["link_down"] == 1.0
+    assert spec.slices(default_chips=4)[0].label == "v5p-8"
+    # no chips pinned -> the pod's size
+    spec2 = load_campaign_spec({"seed": 1})
+    assert spec2.slices(default_chips=4)[0].label == "v5p-4"
+
+
+@pytest.mark.parametrize("mutate, code", [
+    ({"faults": {"kinds": ["gamma_burst"]}}, "TL210"),
+    ({"scenarios": 0}, "TL210"),
+    ({"faults": {"count": {"dist": "gaussian"}}}, "TL210"),
+    ({"faults": {"count": {"dist": "uniform", "min": 0,
+                           "max": 10 ** 9}}}, "TL210"),
+    ({"faults": {"scale": {"min": 0.0, "max": 0.5}}}, "TL210"),
+    ({"retries": 99}, "TL210"),
+    ({"candidate_slices": []}, "TL211"),
+    ({"candidate_slices": [{"arch": "v5p"}]}, "TL211"),
+    ({"slo": {"step_time_ms": 1.0}}, "TL211"),   # slo w/o candidates
+    ({"slo": {"step_time_ms": 1.0, "percentile": 0},
+      "candidate_slices": [{"arch": "v5p", "chips": 4}]}, "TL212"),
+    ({"slo": {"step_time_ms": 1.0, "percentile": 101},
+      "candidate_slices": [{"arch": "v5p", "chips": 4}]}, "TL212"),
+])
+def test_spec_rejections_carry_stable_codes(mutate, code):
+    with pytest.raises(CampaignSpecError) as e:
+        load_campaign_spec(base_spec(**mutate))
+    assert e.value.code == code
+
+
+def test_group_link_absent_from_torus_is_tl213():
+    from tpusim.analysis import analyze_campaign_spec
+
+    doc = base_spec(correlated_groups=[
+        {"name": "ghost", "prob": 0.5,
+         "links": [[[0, 0, 0], [3, 0, 0]]]},   # not a 2x2x2 edge
+    ])
+    diags = analyze_campaign_spec(doc, default_chips=8)
+    assert "TL213" in diags.codes()
+    assert diags.has_errors
+    # axis out of range too
+    diags = analyze_campaign_spec(
+        base_spec(correlated_groups=[
+            {"name": "hyper", "prob": 0.5, "axis": 7},
+        ]),
+        default_chips=8,
+    )
+    assert "TL213" in diags.codes()
+
+
+def test_runner_enforces_validation_before_pricing(tmp_path):
+    from tpusim.analysis import ValidationError
+
+    with pytest.raises(ValidationError, match="TL213"):
+        run_campaign(
+            base_spec(correlated_groups=[
+                {"name": "ghost", "prob": 0.5,
+                 "links": [[[0, 0, 0], [3, 0, 0]]]},
+            ]),
+            trace_path=TRACE, out_dir=tmp_path / "c",
+        )
+    # nothing journaled: the campaign failed before scenario 0
+    assert not (tmp_path / "c" / "journal.jsonl").exists()
+
+
+def test_resume_without_out_dir_is_refused():
+    with pytest.raises(ValueError, match="journal"):
+        run_campaign(base_spec(), trace_path=TRACE, resume=True)
+
+
+def test_one_chip_slice_skips_impossible_link_faults():
+    """A 1-chip slice has no ICI links; link-kind draws are omitted
+    (the zero-fault scenario is a legitimate sample), never a crash."""
+    res = run_campaign(
+        base_spec(chips=1, scenarios=4,
+                  faults={"count": {"dist": "fixed", "n": 2},
+                          "kinds": ["link_down", "link_degraded"]}),
+        trace_path=TRACE,
+    )
+    assert all(r["status"] == "ok" for r in res.doc["rows"])
+    assert all(r["num_faults"] == 0 for r in res.doc["rows"])
+
+
+# -- sampling ----------------------------------------------------------------
+
+def test_sampling_is_seed_deterministic_and_order_free():
+    from tpusim.ici.topology import torus_for
+
+    spec = load_campaign_spec(base_spec(seed=99))
+    topo = torus_for(8, "v5p")
+    a = [sample_schedule_doc(spec, topo, "v5p-8", i) for i in range(6)]
+    # per-scenario substreams: regenerating out of order changes nothing
+    b = [
+        sample_schedule_doc(spec, topo, "v5p-8", i)
+        for i in (5, 3, 1, 0, 2, 4)
+    ]
+    assert a == [b[3], b[2], b[4], b[1], b[5], b[0]]
+    # a different slice label draws a different stream
+    c = sample_schedule_doc(spec, topo, "v5p-64", 0)
+    assert c != a[0] or not a[0]["faults"]
+    # every sampled record passes the schedule loader untouched
+    from tpusim.faults import load_fault_schedule
+
+    for doc in a:
+        load_fault_schedule(doc)
+
+
+def test_percentile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(vals, 50.0) == 2.0
+    assert percentile(vals, 75.0) == 3.0
+    assert percentile(vals, 99.0) == 4.0
+    assert percentile(vals, 100.0) == 4.0
+    assert percentile([], 50.0) is None
+
+
+# -- report determinism ------------------------------------------------------
+
+def test_same_seed_campaign_twice_byte_identical():
+    spec = base_spec(
+        scenarios=5,
+        slo={"step_time_ms": 5.0, "percentile": 80},
+        candidate_slices=[{"arch": "v5p", "chips": 4}],
+    )
+    r1 = run_campaign(spec, trace_path=TRACE)
+    r2 = run_campaign(spec, trace_path=TRACE)
+    b1 = json.dumps(r1.doc, indent=1, sort_keys=True)
+    b2 = json.dumps(r2.doc, indent=1, sort_keys=True)
+    assert b1 == b2
+    # the contract pieces the issue names, present in one document
+    sl = r1.doc["slices"][0]
+    assert {"p50", "p95", "p99", "max"} <= set(sl["inflation"])
+    assert "partition_rate" in sl
+    assert "capacity" in r1.doc
+    for row in r1.doc["capacity"]["table"]:
+        assert "healthy_watts" in row and "meets" in row
+
+
+def test_different_seed_changes_the_report():
+    r1 = run_campaign(base_spec(seed=1, scenarios=5), trace_path=TRACE)
+    r2 = run_campaign(base_spec(seed=2, scenarios=5), trace_path=TRACE)
+    assert r1.doc["rows"] != r2.doc["rows"]
+
+
+# -- partitioned outcomes ----------------------------------------------------
+
+def test_partitioned_topology_is_an_outcome_row_not_a_crash():
+    # a dim-2 axis bundle at prob 1.0: every scenario severs the y-axis
+    # entirely, disconnecting the replaying chips
+    res = run_campaign(
+        base_spec(
+            scenarios=3,
+            faults={"count": {"dist": "fixed", "n": 0}},
+            correlated_groups=[
+                {"name": "bundle-y", "prob": 1.0, "axis": 1},
+            ],
+            slo={"step_time_ms": 1.0, "percentile": 99},
+            candidate_slices=[{"arch": "v5p", "chips": 8}],
+        ),
+        trace_path=TRACE,
+    )
+    rows = res.doc["rows"]
+    assert rows and all(r["partitioned"] is True for r in rows)
+    assert all(r["status"] == "partitioned" for r in rows)
+    sl = res.doc["slices"][0]
+    assert sl["partition_rate"] == 1.0
+    # no step time exists at any percentile: the SLO cannot be met
+    assert sl["slo"]["step_ms_at_percentile"] is None
+    assert sl["slo"]["meets"] is False
+    assert res.doc["capacity"]["smallest_meeting_slice"] is None
+    assert res.stats.partitioned == res.stats.scenarios
+
+
+def test_failed_scenarios_retry_then_land_as_outcomes(monkeypatch):
+    import tpusim.campaign.runner as runner_mod
+
+    calls = {"n": 0}
+    orig = runner_mod._price
+
+    def flaky(pod, cfg, topo, faults, cache, workers):
+        if faults is not None:
+            calls["n"] += 1
+            raise OSError("transient infra failure")
+        return orig(pod, cfg, topo, faults, cache, workers)
+
+    monkeypatch.setattr(runner_mod, "_price", flaky)
+    naps = []
+    res = run_campaign(
+        base_spec(scenarios=2, retries=2, backoff_s=0.01,
+                  faults={"count": {"dist": "fixed", "n": 1},
+                          "kinds": ["link_degraded"],
+                          "scale": {"min": 0.5, "max": 0.5}}),
+        trace_path=TRACE, sleep=naps.append,
+    )
+    rows = res.doc["rows"]
+    assert all(r["status"] == "failed" for r in rows)
+    assert all("transient infra failure" in r["error"] for r in rows)
+    # 2 scenarios x (1 try + 2 retries), with a backoff nap per retry
+    assert calls["n"] == 6
+    assert len(naps) == 4
+    assert res.stats.retries == 4 and res.stats.failed == 2
+
+
+# -- journal -----------------------------------------------------------------
+
+def test_journal_drops_torn_trailing_line(tmp_path):
+    j = Journal(tmp_path)
+    j.append({"kind": "header", "spec_hash": "x", "seed": 1,
+              "model_version": "m"})
+    j.append({"kind": "scenario", "slice": "s", "index": 0, "row": {}})
+    j.close()
+    # simulate a crash mid-append: torn partial line, no newline
+    with open(j.path, "ab") as f:
+        f.write(b'{"kind": "scenario", "slice": "s", "ind')
+    recs = Journal(tmp_path).read_records()
+    assert [r["kind"] for r in recs] == ["header", "scenario"]
+
+
+def test_journal_mid_file_corruption_raises(tmp_path):
+    j = Journal(tmp_path)
+    j.append({"kind": "header"})
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(b"garbage not json\n")
+        f.write(b'{"kind": "scenario"}\n')
+    with pytest.raises(JournalError, match="corrupt"):
+        Journal(tmp_path).read_records()
+
+
+def test_journal_refuses_foreign_resume(tmp_path):
+    j = Journal(tmp_path)
+    j.open_fresh({"spec_hash": "aaaa", "seed": 1, "model_version": "m"})
+    j.close()
+    with pytest.raises(JournalError, match="spec_hash"):
+        Journal(tmp_path).open_resume(
+            {"spec_hash": "bbbb", "seed": 1, "model_version": "m"}
+        )
+    with pytest.raises(JournalError, match="refusing"):
+        Journal(tmp_path).open_resume(
+            {"spec_hash": "aaaa", "seed": 2, "model_version": "m"}
+        )
+
+
+def test_fresh_journal_refuses_to_clobber(tmp_path):
+    spec = base_spec(scenarios=2)
+    run_campaign(spec, trace_path=TRACE, out_dir=tmp_path)
+    with pytest.raises(JournalError, match="resume"):
+        run_campaign(spec, trace_path=TRACE, out_dir=tmp_path)
+
+
+# -- crash-safe resume -------------------------------------------------------
+
+KILL_SCRIPT = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from tpusim.campaign import run_campaign
+
+done = 0
+def progress(msg):
+    global done
+    done += 1
+    if done == {kill_after}:
+        os.kill(os.getpid(), signal.SIGKILL)   # no cleanup, no atexit
+
+run_campaign(
+    {spec!r}, trace_path={trace!r}, out_dir={out!r}, progress=progress,
+)
+"""
+
+
+def test_resume_after_sigkill_reprices_zero_completed(tmp_path):
+    """SIGKILL mid-campaign; --resume completes the run while re-pricing
+    ONLY the scenarios the journal does not already hold."""
+    spec = base_spec(scenarios=6, seed=21)
+    out = tmp_path / "camp"
+    kill_after = 3
+    script = KILL_SCRIPT.format(
+        repo=str(REPO), spec=spec, trace=str(TRACE), out=str(out),
+        kill_after=kill_after,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    recs = Journal(out).read_records()
+    pre = [r for r in recs if r.get("kind") == "scenario"]
+    assert len(pre) == kill_after     # journal is a true prefix
+
+    import tpusim.campaign.runner as runner_mod
+
+    priced = {"n": 0, "faulted": 0}
+    orig = runner_mod._price
+
+    def counting(pod, cfg, topo, faults, cache, workers):
+        priced["n"] += 1
+        if faults is not None:
+            priced["faulted"] += 1
+        return orig(pod, cfg, topo, faults, cache, workers)
+
+    runner_mod._price = counting
+    try:
+        res = run_campaign(
+            spec, trace_path=TRACE, out_dir=out, resume=True,
+        )
+    finally:
+        runner_mod._price = orig
+
+    # zero completed scenarios re-priced: only the remaining 3 ran, and
+    # the healthy baseline came back from the journal (0 healthy runs)
+    assert priced["faulted"] <= spec["scenarios"] - kill_after
+    assert priced["n"] == priced["faulted"]
+    assert res.stats.resumed == kill_after
+    assert res.stats.priced == spec["scenarios"] - kill_after
+
+    recs = Journal(out).read_records()
+    post = [r for r in recs if r.get("kind") == "scenario"]
+    assert len(post) == spec["scenarios"]
+    assert sorted(r["index"] for r in post) == list(range(6))
+
+    # and the stitched report equals a clean single-process run
+    clean = run_campaign(spec, trace_path=TRACE)
+    assert json.dumps(res.doc, sort_keys=True) == \
+        json.dumps(clean.doc, sort_keys=True)
+
+
+# -- JobTable persistence ----------------------------------------------------
+
+def test_jobtable_persists_and_recovers(tmp_path):
+    from tpusim.serve.admission import JobTable
+
+    t1 = JobTable(persist_dir=tmp_path)
+    job_q = t1.submit("campaign", {"spec": {"seed": 1}})
+    job_r = t1.submit("sweep", {"chips": 8})
+    job_d = t1.submit("sweep", {"chips": 27})
+    assert t1.next_job(timeout_s=0.01) is job_q   # queued -> running
+    t1.finish(job_d, {"ok": 1}, None)
+    files = sorted(p.name for p in tmp_path.glob("job-*.json"))
+    assert files == [
+        "job-000001.json", "job-000002.json", "job-000003.json",
+    ]
+
+    # "restart": a fresh table over the same dir
+    t2 = JobTable(persist_dir=tmp_path)
+    assert t2.recovered == 2          # running + queued re-enqueue
+    got = t2.get(job_q.job_id)
+    assert got is not None and got.status == "queued"
+    assert got.kind == "campaign"
+    assert got.request == {"spec": {"seed": 1}}
+    done = t2.get(job_d.job_id)
+    assert done.status == "done" and done.result == {"ok": 1}
+    # recovered jobs drain in submission order under the SAME ids
+    assert t2.next_job(timeout_s=0.01).job_id == job_q.job_id
+    assert t2.next_job(timeout_s=0.01).job_id == job_r.job_id
+    # ids continue past the recovered ones
+    assert t2.submit("sweep", {}).job_id == "job-000004"
+
+
+# -- the daemon path ---------------------------------------------------------
+
+@pytest.fixture
+def serve_daemon_factory():
+    daemons = []
+
+    def make(**kw):
+        from tpusim.serve.daemon import ServeDaemon
+
+        d = ServeDaemon(trace_root=FIXTURES, **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield make
+    for d in daemons:
+        if not d._stopped.is_set():
+            d.abort()
+
+
+def test_daemon_restart_resumes_queued_campaign_job(
+    tmp_path, serve_daemon_factory,
+):
+    """A queued ``POST /v1/campaign`` job survives a daemon crash: the
+    restarted daemon re-enqueues it from the persisted spec, runs it to
+    completion under the same job id, and journals under --state-dir."""
+    from tpusim.serve.client import ServeClient
+
+    spec = base_spec(scenarios=3, seed=8)
+    state = tmp_path / "state"
+
+    # job workers held at 0: the job is accepted + persisted, never run
+    d1 = serve_daemon_factory(state_dir=state, job_workers=0)
+    c1 = ServeClient(d1.url)
+    job_id = c1.campaign(spec=spec, trace="llama_tiny_tp2dp2")
+    assert c1.job(job_id).status == "queued"
+    d1.abort()                        # crash: no drain, no cleanup
+
+    d2 = serve_daemon_factory(state_dir=state, job_workers=1)
+    assert d2.jobs.recovered == 1
+    c2 = ServeClient(d2.url)
+    st = c2.wait_job(job_id, timeout_s=120)
+    assert st.status == "done", st.error
+    doc = st.result
+    assert doc["seed"] == 8
+    assert doc["slices"][0]["scenarios"] == 3
+    assert (state / "campaigns" / job_id / "journal.jsonl").is_file()
+    assert d2.drain_and_stop()
+
+    # the served report matches the CLI path byte for byte
+    clean = run_campaign(spec, trace_path=TRACE)
+    assert json.dumps(doc, sort_keys=True) == \
+        json.dumps(clean.doc, sort_keys=True)
+
+
+def test_bad_campaign_spec_fails_the_job_with_the_code(
+    serve_daemon_factory,
+):
+    """Submission is async (202 always); a bad spec is refused when the
+    job runs, landing as a failed job carrying the loader's message —
+    never a daemon crash."""
+    from tpusim.serve.client import ServeClient
+
+    d = serve_daemon_factory()
+    c = ServeClient(d.url)
+    job_id = c.campaign(
+        trace="llama_tiny_tp2dp2",
+        spec={"seed": 1, "faults": {"kinds": ["tachyon_storm"]}},
+    )
+    st = c.wait_job(job_id, timeout_s=60)
+    assert st.status == "failed"
+    assert "tachyon_storm" in st.error
+    assert d.drain_and_stop()

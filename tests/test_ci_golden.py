@@ -34,9 +34,16 @@ def test_golden_catches_model_change(matrix_results):
 
 
 def test_golden_files_are_committed():
+    # one stats golden per matrix row, plus the campaign-smoke report
+    # (a different document shape, pinned by --campaign-smoke)
     goldens = list((REPO / "ci" / "golden").glob("*.json"))
-    assert len(goldens) == len(check_golden.MATRIX)
-    for g in goldens:
+    matrix = [
+        g for g in goldens
+        if g != check_golden.CAMPAIGN_SMOKE_GOLDEN
+    ]
+    assert len(matrix) == len(check_golden.MATRIX)
+    assert check_golden.CAMPAIGN_SMOKE_GOLDEN in goldens
+    for g in matrix:
         data = json.loads(g.read_text())
         assert "sim_cycle" in data
         for vol in check_golden.VOLATILE:

@@ -160,6 +160,25 @@ XPLANE_FIXTURE = (
 )
 
 
+def _require_xplane_support() -> None:
+    """Skip (never error) when the optional xplane parser is absent.
+
+    The extraction path needs ``jax.profiler.ProfileData``, which only
+    some jax builds ship (it rides the bundled tensorflow-profiler
+    protos).  An environment without it cannot exercise these tests at
+    all — that is a missing optional dep, not a regression — but when
+    the import DOES resolve, any failure inside the tests is real and
+    must surface."""
+    pytest.importorskip("jax")
+    try:
+        from jax.profiler import ProfileData  # noqa: F401
+    except ImportError as e:
+        pytest.skip(
+            f"optional xplane support missing: jax.profiler.ProfileData "
+            f"not importable in this jax build ({e})"
+        )
+
+
 def test_event_op_name_real_tpu_shapes():
     from tpusim.harness.correl_ops import _event_op_name
 
@@ -172,7 +191,7 @@ def test_event_op_name_real_tpu_shapes():
 
 
 def test_extract_op_profile_real_tpu_xplane():
-    pytest.importorskip("jax")
+    _require_xplane_support()
     from tpusim.harness.correl_ops import extract_op_profile
 
     ops = extract_op_profile(XPLANE_FIXTURE)
@@ -189,7 +208,7 @@ def test_extract_op_profile_real_tpu_xplane():
 
 
 def test_extract_module_profile_real_tpu_xplane():
-    pytest.importorskip("jax")
+    _require_xplane_support()
     from tpusim.harness.correl_ops import extract_module_profile
 
     mods = extract_module_profile(XPLANE_FIXTURE)
@@ -203,7 +222,7 @@ def test_extract_module_profile_real_tpu_xplane():
 def test_correlate_ops_matches_real_tpu_event_names():
     """End-to-end name matching: engine per-op names vs real-TPU event
     text must line up (the round-3 matcher matched ZERO ops)."""
-    pytest.importorskip("jax")
+    _require_xplane_support()
     from tpusim.harness.correl_ops import extract_op_profile
 
     silicon = extract_op_profile(XPLANE_FIXTURE)
@@ -227,7 +246,7 @@ def test_correlate_counters_from_real_xplane():
     """Counter-level cross-check (VERDICT r3 #8): achieved HBM GB/s of the
     heaviest streaming op derived from static bytes + measured device
     time, vs the model's streaming rate."""
-    pytest.importorskip("jax")
+    _require_xplane_support()
     from tpusim.harness.correl_ops import (
         correlate_counters, extract_op_profile,
     )
@@ -279,7 +298,7 @@ def test_correlate_counters_skips_non_mxu_and_zero_traffic():
     """A VPU-only fusion (flops but no mxu_flops) must not masquerade as
     the MXU check, and zero-traffic entries must not report 0 GB/s as if
     it were a measurement."""
-    pytest.importorskip("jax")
+    _require_xplane_support()
     from tpusim.harness.correl_ops import (
         correlate_counters, extract_op_profile,
     )

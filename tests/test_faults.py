@@ -130,6 +130,44 @@ def test_schedule_roundtrip_and_windows():
     assert state.view_at(0.0) is state.view_at(2500.0)
 
 
+def test_overlapping_fault_composition_is_order_independent():
+    """Same-resource faults stack multiplicatively in a deterministic
+    order: a generated schedule must produce a bit-identical view however
+    its records are ordered (float products are not associative, so the
+    naive file-order product can differ in the last ulp)."""
+    topo = torus_for(64, "v5p")
+    # three scales chosen so the float64 product depends on order
+    scales = [0.6375365295912734, 0.8810846638965013, 0.5785151418630428]
+    a_, b_, c_ = scales
+    assert (a_ * b_) * c_ != (a_ * c_) * b_
+    throttles = [
+        {"kind": "hbm_throttle", "chip": 5, "hbm_scale": s}
+        for s in scales
+    ]
+    degrades = [
+        {"kind": "link_degraded", "src": [0, 0, 0], "dst": [0, 1, 0],
+         "bandwidth_scale": s}
+        for s in scales
+    ]
+    views = []
+    for order in ([0, 1, 2], [2, 0, 1], [1, 2, 0]):
+        doc = {"faults": [throttles[i] for i in order]
+               + [degrades[i] for i in order]}
+        views.append(load_fault_schedule(doc).bind(topo).view_at(0.0))
+    ref = views[0]
+    for v in views[1:]:
+        assert v.chip_hbm == ref.chip_hbm
+        assert v.scales == ref.scales
+        assert v.signature == ref.signature
+    # and the composition really is the multiplicative stack
+    a, b = topo.chip_at((0, 0, 0)), topo.chip_at((0, 1, 0))
+    prod = 1.0
+    for s in sorted(scales):
+        prod *= s
+    assert ref.chip_scales(5)[1] == prod
+    assert ref.link_scale(a, b) == prod
+
+
 # -- link-down routing (detailed network) -----------------------------------
 
 def test_route_around_dead_link_is_longer_and_live():

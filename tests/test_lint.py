@@ -98,6 +98,14 @@ def _schedule_defect(doc: dict):
     return build
 
 
+def _campaign_defect(doc: dict):
+    def build(tmp_path: Path) -> Diagnostics:
+        from tpusim.analysis import analyze_campaign_spec
+
+        return analyze_campaign_spec(doc, default_chips=64)
+    return build
+
+
 def _statskey_defect(files: dict[str, str], schema: dict | None = None):
     """Seed a miniature repo with the audited layout and run the
     stats-key contract pass against it."""
@@ -286,6 +294,25 @@ ENTRY %main (p0: f32[8]) -> f32[8] {
     ("no-effect-scale", {"TL204"}, _schedule_defect(
         {"faults": [{"kind": "hbm_throttle", "chip": 3,
                      "hbm_scale": 1.0}]},
+    )),
+    ("campaign-unknown-kind", {"TL210"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4,
+         "faults": {"kinds": ["cosmic_ray"]}},
+    )),
+    ("campaign-empty-candidates", {"TL211"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4, "candidate_slices": []},
+    )),
+    ("campaign-percentile", {"TL212"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4,
+         "slo": {"step_time_ms": 2.0, "percentile": 250},
+         "candidate_slices": [{"arch": "v5p", "chips": 16}]},
+    )),
+    ("campaign-absent-group-link", {"TL213"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4, "arch": "v5p", "chips": 64,
+         "correlated_groups": [
+             {"name": "ghost-bundle", "prob": 0.5,
+              "links": [[[0, 0, 0], [2, 0, 0]]]},
+         ]},
     )),
     ("statskey-ownership", {"TL301"}, _statskey_defect({
         "tpusim/timing/engine.py":

@@ -386,6 +386,78 @@ def _cmd_faults(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_campaign(args: argparse.Namespace) -> int:
+    """Monte-Carlo compound-fault campaign: sample N fault scenarios
+    per pod slice from a seeded spec, price each through the shared
+    result cache, and report inflation distributions + the SLO
+    capacity answer.  Crash-safe: re-run with --resume to continue a
+    killed campaign from its last journaled scenario."""
+    from tpusim.analysis import ValidationError
+    from tpusim.campaign import JournalError, run_campaign
+
+    progress = None
+    if args.verbose:
+        def progress(msg: str) -> None:
+            print(f"  {msg}", file=sys.stderr)
+    try:
+        res = run_campaign(
+            args.spec,
+            trace_path=args.trace,
+            out_dir=args.out,
+            resume=args.resume,
+            result_cache=args.result_cache,
+            workers=args.workers,
+            progress=progress,
+        )
+    except ValidationError as e:
+        print(f"tpusim campaign: spec refused:\n{e}", file=sys.stderr)
+        return 1
+    except JournalError as e:
+        # existing-journal / foreign-resume refusals are user errors
+        # with a clear next step, not tracebacks
+        print(f"tpusim campaign: {e}", file=sys.stderr)
+        return 1
+    doc = res.doc
+    s = res.stats
+    print(f"tpusim campaign: {doc['campaign']!r} seed={doc['seed']} "
+          f"spec={doc['spec_hash']} trace={doc['trace']}")
+    print(f"  {s.priced} scenario(s) priced, {s.resumed} resumed from "
+          f"journal, {s.partitioned} partitioned, {s.failed} failed "
+          f"({res.wall_seconds:.2f}s)")
+    for sl in doc["slices"]:
+        infl = sl["inflation"]
+        line = (f"  {sl['label']:12s} {sl['scenarios']} scenarios, "
+                f"partition rate {sl['partition_rate']:.1%}")
+        if infl is not None:
+            line += (f"; inflation p50 {infl['p50']:.3f}x "
+                     f"p95 {infl['p95']:.3f}x p99 {infl['p99']:.3f}x "
+                     f"max {infl['max']:.3f}x")
+        slo = sl.get("slo")
+        if slo is not None:
+            at = slo["step_ms_at_percentile"]
+            shown = f"{at:.3f}ms" if at is not None else "unbounded"
+            line += (f"; p{slo['percentile']:g} step {shown} vs SLO "
+                     f"{slo['step_time_ms']:g}ms -> "
+                     f"{'MEETS' if slo['meets'] else 'MISSES'}")
+        print(line)
+    cap = doc.get("capacity")
+    if cap is not None:
+        best = cap["smallest_meeting_slice"]
+        print(f"  capacity: smallest slice meeting "
+              f"{cap['slo_step_time_ms']:g}ms @ p{cap['percentile']:g} "
+              f"under sampled degradation: {best or 'NONE'}")
+    for k, v in s.stats_dict().items():
+        print(f"  {k} = {v:.0f}")
+    if res.report_path is not None:
+        print(f"  report written to {res.report_path}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"  report also written to {args.json}")
+    return 0
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     """Long-running simulation service (tpusim.serve): JSON API over
     HTTP with hot traces, admission control, a process-wide shared
@@ -402,8 +474,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         max_request_bytes=args.max_request_bytes,
         result_cache=args.result_cache,
         workers=args.workers or 1,
-        job_workers=args.job_workers,
+        # clamp at 1: job_workers=0 is the in-process test hook (accept
+        # + persist jobs without draining them); a served daemon must
+        # always drain its queue
+        job_workers=max(args.job_workers, 1),
         drain_grace_s=args.drain_grace_s,
+        state_dir=args.state_dir,
         verbose=args.verbose,
     )
     daemon.install_signal_handlers()
@@ -461,9 +537,10 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         for line in list_code_lines():
             print(line)
         return 0
-    if args.trace is None and not args.stats_keys:
+    if args.trace is None and not args.stats_keys and not args.campaign:
         print("tpusim lint: nothing to analyze — pass a trace dir, "
-              "--stats-keys, or --list-codes", file=sys.stderr)
+              "--campaign, --stats-keys, or --list-codes",
+              file=sys.stderr)
         return 2
     if args.trace is None and (args.faults or args.config or args.arch):
         print("tpusim lint: --faults/--config/--arch need a trace dir "
@@ -476,6 +553,20 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         analyze_trace_dir(
             args.trace, arch=args.arch, overlays=list(args.config or []),
             faults=args.faults, diags=diags,
+        )
+    if args.campaign:
+        from tpusim.analysis import analyze_campaign_spec
+
+        default_chips = 1
+        if args.trace is not None:
+            # size the primary slice the way the campaign runner would
+            from tpusim.analysis.trace_passes import load_parsed_trace
+
+            default_chips = max(
+                load_parsed_trace(args.trace).replay_devices, 1
+            )
+        analyze_campaign_spec(
+            args.campaign, diags=diags, default_chips=default_chips,
         )
     if args.stats_keys:
         analyze_stats_keys(diags=diags)
@@ -983,11 +1074,44 @@ def main(argv: list[str] | None = None) -> int:
                           "tier)")
     pfa.set_defaults(fn=_cmd_faults)
 
+    pcm = sub.add_parser(
+        "campaign",
+        help="seeded Monte-Carlo compound-fault campaign: N sampled "
+             "degradation scenarios per pod slice -> inflation "
+             "distributions (p50/p95/p99/max), partition rate, energy "
+             "deltas, and the smallest slice meeting a step-time SLO",
+    )
+    pcm.add_argument("spec", help="campaign spec JSON (see "
+                                  "docs/ARCHITECTURE.md)")
+    pcm.add_argument("--trace", required=True,
+                     help="trace directory the campaign replays")
+    pcm.add_argument("--out", default=None, metavar="DIR",
+                     help="campaign state dir: crash-safe journal.jsonl "
+                          "+ report.json (required for --resume)")
+    pcm.add_argument("--resume", action="store_true",
+                     help="continue a killed campaign from the last "
+                          "journaled scenario in --out (completed "
+                          "scenarios are never re-priced)")
+    pcm.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="fan each replay's module pricing over N "
+                          "processes (scenarios run serially so the "
+                          "journal stays a true prefix)")
+    pcm.add_argument("--result-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="share the engine-result cache on disk "
+                          "(in-memory sharing across scenarios is "
+                          "always on; this persists it across runs)")
+    pcm.add_argument("--json", default=None,
+                     help="also write the report document here")
+    pcm.add_argument("--verbose", action="store_true",
+                     help="per-scenario progress on stderr")
+    pcm.set_defaults(fn=_cmd_campaign)
+
     psv = sub.add_parser(
         "serve",
         help="simulation-as-a-service daemon: JSON API (simulate/lint/"
-             "sweep/jobs/healthz/metrics) with hot traces, admission "
-             "control, shared result cache, SIGTERM drain",
+             "sweep/campaign/jobs/healthz/metrics) with hot traces, "
+             "admission control, shared result cache, SIGTERM drain",
     )
     psv.add_argument("--host", default="127.0.0.1")
     psv.add_argument("--port", type=int, default=8642,
@@ -1026,6 +1150,11 @@ def main(argv: list[str] | None = None) -> int:
     psv.add_argument("--drain-grace-s", type=float, default=60.0,
                      help="SIGTERM drain budget before giving up on "
                           "in-flight work")
+    psv.add_argument("--state-dir", default=None, metavar="DIR",
+                     help="persist accepted async job specs (and "
+                          "campaign journals) here: a restarted daemon "
+                          "re-enqueues queued/running jobs and resumes "
+                          "campaigns from their last completed scenario")
     psv.add_argument("--verbose", action="store_true",
                      help="per-request access log on stderr")
     psv.set_defaults(fn=_cmd_serve)
@@ -1070,6 +1199,11 @@ def main(argv: list[str] | None = None) -> int:
     pli.add_argument("--faults", default=None, metavar="SCHEDULE.json",
                      help="fault schedule to validate against the "
                           "trace's declared topology")
+    pli.add_argument("--campaign", default=None, metavar="SPEC.json",
+                     help="campaign spec to validate (TL21x codes: "
+                          "format, candidate slices, SLO percentile, "
+                          "correlated-group links); works with or "
+                          "without a trace dir")
     pli.add_argument("--format", choices=["text", "json"],
                      default="text",
                      help="diagnostic output format (json is the "

@@ -17,6 +17,7 @@ from tpusim.analysis.diagnostics import (
     Severity,
     list_code_lines,
 )
+from tpusim.analysis.campaign_passes import analyze_campaign_spec
 from tpusim.analysis.runner import (
     ValidationError,
     analyze_config,
@@ -34,6 +35,7 @@ __all__ = [
     "Severity",
     "STATS_NAMESPACES",
     "ValidationError",
+    "analyze_campaign_spec",
     "analyze_config",
     "analyze_schedule",
     "analyze_stats_keys",

@@ -107,6 +107,14 @@ _code("TL202", _E, "fault endpoint/link does not exist on the declared "
 _code("TL203", _W, "overlapping faults target the same link or chip")
 _code("TL204", _I, "fault with scale 1.0 has no effect")
 
+# --- campaign passes (TL21x) -----------------------------------------------
+_code("TL210", _E, "campaign spec fails format validation (unknown fault "
+                   "kind, bad distribution, scale out of range)")
+_code("TL211", _E, "campaign candidate-slice list empty or invalid")
+_code("TL212", _E, "campaign SLO percentile outside (0, 100]")
+_code("TL213", _E, "campaign correlated group references links or axes "
+                   "absent from the slice torus")
+
 # --- stats-key contract (TL3xx) --------------------------------------------
 _code("TL301", _E, "stats key written outside its namespace's owning "
                    "subsystem")

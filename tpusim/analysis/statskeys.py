@@ -70,6 +70,14 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     "serve_": (
         "tpusim/serve/", "ci/check_golden.py",
     ),
+    # the campaign layer (PR 6): Monte-Carlo executor accounting
+    # (scenarios priced/resumed, partition + failure counts, retries) —
+    # stamped only when a campaign actually ran; tpusim.serve mirrors
+    # them on /metrics for async campaign jobs
+    "campaign_": (
+        "tpusim/campaign/", "tpusim/serve/", "tpusim/__main__.py",
+        "ci/check_golden.py",
+    ),
 }
 
 #: keys deliberately shared across surfaces, with the subsystems licensed
@@ -108,6 +116,7 @@ AUDIT_GLOBS = (
     "tpusim/ici/*.py",
     "tpusim/perf/*.py",
     "tpusim/serve/*.py",
+    "tpusim/campaign/*.py",
     "tpusim/timing/engine.py",
 )
 

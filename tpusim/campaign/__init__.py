@@ -1,0 +1,48 @@
+"""Monte-Carlo compound-fault campaigns (``tpusim.campaign``).
+
+The fleet-planning pillar over :mod:`tpusim.faults`: where a fault
+sweep answers "what does ONE dead link cost?", a campaign answers "what
+does my step-time distribution look like under *realistic compound
+degradation* — k simultaneous faults, correlated cable-bundle outages,
+straggler + HBM-throttle mixes — and what is the smallest pod slice
+that still meets my SLO at p99?".
+
+Four pieces: declarative specs with a PRNG seed
+(:mod:`~tpusim.campaign.spec`), per-scenario substream sampling
+(:mod:`~tpusim.campaign.sample`), a crash-safe resumable executor over
+the shared engine-result cache (:mod:`~tpusim.campaign.runner` +
+:mod:`~tpusim.campaign.journal`), and distribution/capacity reports
+joining the power model (:mod:`~tpusim.campaign.report`).  Reached via
+``python -m tpusim campaign`` and ``POST /v1/campaign``.
+"""
+
+from tpusim.campaign.journal import Journal, JournalError
+from tpusim.campaign.report import build_report, percentile
+from tpusim.campaign.runner import (
+    CampaignResult,
+    CampaignStats,
+    run_campaign,
+)
+from tpusim.campaign.sample import sample_schedule_doc, scenario_rng
+from tpusim.campaign.spec import (
+    CampaignSpec,
+    CampaignSpecError,
+    load_campaign_spec,
+    spec_hash,
+)
+
+__all__ = [
+    "CampaignResult",
+    "CampaignSpec",
+    "CampaignSpecError",
+    "CampaignStats",
+    "Journal",
+    "JournalError",
+    "build_report",
+    "load_campaign_spec",
+    "percentile",
+    "run_campaign",
+    "sample_schedule_doc",
+    "scenario_rng",
+    "spec_hash",
+]

@@ -1,0 +1,433 @@
+"""Compound-fault campaign executor.
+
+Prices N Monte-Carlo-sampled fault scenarios per pod slice through the
+shared engine-result cache and journals every outcome to disk before
+moving on.  The three contracts:
+
+* **Reproducible** — scenario schedules come from per-scenario PRNG
+  substreams (:mod:`tpusim.campaign.sample`) and the report is a pure
+  function of the outcome rows, so a fixed seed reproduces the report
+  document byte-for-byte.
+* **Cheap where it can be** — all replays (baselines and every scenario
+  of every slice) share ONE :class:`tpusim.perf.ResultCache`: modules
+  without collectives price identically on any pod, so the healthy
+  kernel class prices once per campaign, not once per scenario — the
+  same trick that makes ``trace_step_sweep`` linear only in the
+  fault-sensitive work.
+* **Crash-safe** — completed scenarios journal incrementally
+  (:mod:`tpusim.campaign.journal`); ``resume=True`` (the ``--resume``
+  flag, and the serve tier's restart path) re-prices nothing that
+  already landed.  Per-scenario failures retry with procman-style
+  exponential backoff + deterministic jitter; scenarios that still fail
+  — a partitioned topology above all — are recorded as OUTCOME rows
+  (``status: "partitioned"`` / ``"failed"``), never crashes: a fleet
+  campaign's whole point is measuring how often the pod breaks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpusim.campaign.journal import Journal
+from tpusim.campaign.report import build_report
+from tpusim.campaign.sample import sample_schedule_doc, scenario_rng
+from tpusim.campaign.spec import CampaignSpec, load_campaign_spec, spec_hash
+
+__all__ = ["CampaignResult", "CampaignStats", "run_campaign"]
+
+#: backoff ceiling (mirrors harness.procman's discipline)
+_MAX_BACKOFF_S = 30.0
+
+
+@dataclass
+class CampaignStats:
+    """Executor accounting — the ``campaign_*`` stats namespace
+    (registered in :mod:`tpusim.analysis.statskeys`).  Ride reports and
+    ``/metrics`` only when a campaign actually ran — the healthy
+    simulate path never stamps them."""
+
+    slices: int = 0
+    scenarios: int = 0
+    #: scenarios whose replay actually priced to completion this run
+    #: (partitioned/failed outcomes and journal-restored rows are
+    #: counted by their own fields, never here)
+    priced: int = 0
+    resumed: int = 0
+    partitioned: int = 0
+    failed: int = 0
+    retries: int = 0
+
+    def stats_dict(self) -> dict[str, float]:
+        return {
+            "campaign_slices_total": self.slices,
+            "campaign_scenarios_total": self.scenarios,
+            "campaign_scenarios_priced": self.priced,
+            "campaign_scenarios_resumed": self.resumed,
+            "campaign_partitioned_total": self.partitioned,
+            "campaign_failed_total": self.failed,
+            "campaign_retries_total": self.retries,
+        }
+
+
+@dataclass
+class CampaignResult:
+    """One campaign's report document + executor accounting."""
+
+    doc: dict
+    stats: CampaignStats
+    out_dir: Path | None = None
+    report_path: Path | None = None
+    wall_seconds: float = 0.0
+    rows_by_slice: dict = field(default_factory=dict, repr=False)
+
+
+def _pod_devices(pod) -> int:
+    """The driver's pod-size rule, mirrored (the default primary-slice
+    chip count when the spec doesn't pin one)."""
+    return max(
+        int(pod.meta.get("num_devices", 0) or 0),
+        max((m.num_devices for m in pod.modules.values()), default=1),
+        len(pod.devices) or 1,
+    )
+
+
+def _fault_summary(doc: dict) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for rec in doc["faults"]:
+        out[rec["kind"]] = out.get(rec["kind"], 0) + 1
+    return dict(sorted(out.items()))
+
+
+def _disconnected(topo, view, replay_chips: int) -> bool:
+    """Do the dead links disconnect any two replaying chips?
+
+    BFS over directed live links (route-around may pass through
+    non-replaying chips).  The detailed ICI model discovers this itself
+    and raises :class:`TopologyPartitionedError` mid-pricing; the
+    analytic model degrades torus→mesh but never partitions, so the
+    campaign executor owns the check — "would this degradation
+    partition my job's communication?" must not depend on which network
+    model priced the scenario."""
+    if not view.dead:
+        return False
+    from collections import deque
+
+    adj: dict[int, list[int]] = {}
+    for a, b in topo.undirected_links():
+        if view.link_alive(a, b):
+            adj.setdefault(a, []).append(b)
+        if view.link_alive(b, a):
+            adj.setdefault(b, []).append(a)
+    want = set(range(replay_chips))
+    seen = {0}
+    q = deque([0])
+    while q:
+        c = q.popleft()
+        for n in adj.get(c, ()):
+            if n not in seen:
+                seen.add(n)
+                q.append(n)
+    return not want <= seen
+
+
+def _schedule_partitions(state, replay_chips: int) -> bool:
+    """Partition test for one bound schedule: any activation window
+    whose live-link graph disconnects the replaying chips counts (view
+    sets only change at fault start cycles)."""
+    topo = state.topo
+    if not state.windowed:
+        return _disconnected(topo, state.view_at(0.0), replay_chips)
+    boundaries = {0.0}
+    boundaries.update(f.start_cycle for f, _ in state.bound_faults())
+    return any(
+        _disconnected(topo, state.view_at(b), replay_chips)
+        for b in sorted(boundaries)
+    )
+
+
+def _price(pod, cfg, topo, faults, cache, workers):
+    """One replay → (cycles, step_s, watts, energy_j)."""
+    from tpusim.sim.driver import SimDriver
+
+    report = SimDriver(
+        cfg, topology=topo, faults=faults, result_cache=cache,
+        workers=workers,
+    ).run(pod)
+    cycles = report.cycles
+    step_s = cycles / cfg.arch.clock_hz if cfg.arch.clock_hz else 0.0
+    watts = energy = None
+    if report.power is not None:
+        watts = report.power.avg_watts
+        energy = report.power.total_joules
+    return cycles, step_s, watts, energy
+
+
+def _run_scenario(
+    spec: CampaignSpec, pod, cfg, topo, slice_label: str, index: int,
+    healthy: dict, cache, workers, stats: CampaignStats,
+    replay_chips: int, check_partition: bool,
+    sleep=time.sleep,
+) -> tuple[dict, dict]:
+    """Price scenario ``index``: returns ``(row, schedule_doc)``.
+    Failures become outcome rows, never exceptions."""
+    from tpusim.faults import TopologyPartitionedError, load_fault_schedule
+
+    sched_doc = sample_schedule_doc(spec, topo, slice_label, index)
+    row = {
+        "slice": slice_label,
+        "index": index,
+        # "num_faults", not "faults_total": row fields live in the
+        # report document, and a faults_* literal here would trip the
+        # stats-key ownership audit for the faults_* report namespace
+        "faults": _fault_summary(sched_doc),
+        "num_faults": len(sched_doc["faults"]),
+    }
+    sched = load_fault_schedule(sched_doc)
+    if check_partition and _schedule_partitions(
+        sched.bind(topo), replay_chips
+    ):
+        stats.partitioned += 1
+        row.update({
+            "status": "partitioned", "partitioned": True,
+            "error": "dead links disconnect replaying chips",
+        })
+        return row, sched_doc
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            cycles, step_s, watts, energy = _price(
+                pod, cfg, topo, sched, cache, workers,
+            )
+        except TopologyPartitionedError as e:
+            # deterministic refusal: the sampled faults disconnect chips
+            # that must communicate — THE outcome fleet campaigns exist
+            # to count, and retrying cannot change it
+            stats.partitioned += 1
+            row.update({
+                "status": "partitioned", "partitioned": True,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            return row, sched_doc
+        except Exception as e:  # noqa: BLE001 - scenario boundary
+            if attempts <= spec.retries:
+                # procman-style: exponential backoff + deterministic
+                # jitter (a seeded stream, so reruns sleep identically)
+                stats.retries += 1
+                base = spec.backoff_s * (2.0 ** (attempts - 1))
+                jitter = 0.25 * base * scenario_rng(
+                    spec.seed, f"retry:{slice_label}:{attempts}", index
+                ).random()
+                sleep(min(base + jitter, _MAX_BACKOFF_S))
+                continue
+            stats.failed += 1
+            row.update({
+                "status": "failed", "partitioned": False,
+                "error": f"{type(e).__name__}: {e}",
+                "attempts": attempts,
+            })
+            return row, sched_doc
+        stats.priced += 1
+        h = healthy["cycles"]
+        row.update({
+            "status": "ok",
+            "partitioned": False,
+            "cycles": cycles,
+            "inflation": cycles / h if h > 0 else float("inf"),
+            "step_s": step_s,
+            "watts": watts,
+            "energy_j": energy,
+            "energy_delta_j": (
+                energy - healthy["energy_j"]
+                if energy is not None
+                and healthy.get("energy_j") is not None else None
+            ),
+            "perf_per_watt": (
+                (1.0 / step_s) / watts
+                if watts and step_s > 0 else None
+            ),
+        })
+        return row, sched_doc
+
+
+def run_campaign(
+    spec_src,
+    trace_path: str | Path | None = None,
+    pod=None,
+    trace_name: str | None = None,
+    out_dir: str | Path | None = None,
+    resume: bool = False,
+    result_cache=None,
+    workers: int | None = None,
+    validate: bool = True,
+    progress=None,
+    sleep=time.sleep,
+) -> CampaignResult:
+    """Execute one campaign end to end.
+
+    ``spec_src`` is whatever :func:`load_campaign_spec` accepts.  The
+    workload comes from ``trace_path`` or an already-parsed ``pod`` (the
+    serve tier passes its hot registry entry).  ``out_dir`` enables the
+    crash-safe journal + ``report.json``; ``resume=True`` continues a
+    killed campaign from its last completed scenario.  ``result_cache``
+    is shared across every replay (None = fresh in-memory cache);
+    ``workers`` fans each replay's module pricing (scenarios themselves
+    run serially so the journal is always a true prefix).  ``validate``
+    runs the TL2xx campaign passes first and refuses on errors."""
+    from tpusim.ici.topology import torus_for
+    from tpusim.perf.cache import ResultCache, as_result_cache
+    from tpusim.timing.config import load_config
+    from tpusim.timing.model_version import model_version
+
+    t0 = time.perf_counter()
+    if resume and out_dir is None:
+        # silently re-pricing a whole campaign the caller believes is
+        # resuming would be the worst possible interpretation
+        raise ValueError(
+            "resume=True needs the campaign directory that holds the "
+            "journal (--out DIR on the CLI)"
+        )
+    spec = load_campaign_spec(spec_src)
+    if pod is None:
+        if trace_path is None:
+            raise ValueError("run_campaign needs trace_path or pod")
+        from tpusim.trace.format import load_trace
+
+        pod = load_trace(trace_path)
+    if trace_name is None:
+        trace_name = (
+            Path(trace_path).name if trace_path is not None
+            else str(pod.meta.get("name", "inline"))
+        )
+    default_chips = _pod_devices(pod)
+
+    if validate:
+        from tpusim.analysis import ValidationError
+        from tpusim.analysis.campaign_passes import run_campaign_passes
+        from tpusim.analysis.diagnostics import Diagnostics
+
+        diags = Diagnostics()
+        run_campaign_passes(spec, diags, default_chips=default_chips)
+        if diags.has_errors:
+            raise ValidationError(diags)
+
+    digest = spec_hash(spec)
+    header = {
+        "name": spec.name,
+        "spec_hash": digest,
+        "seed": spec.seed,
+        "model_version": model_version(),
+        "trace": trace_name,
+    }
+
+    stats = CampaignStats()
+    cache = as_result_cache(result_cache) or ResultCache()
+    # partition semantics need communicating chips: a pod with no
+    # collectives has nothing to disconnect
+    check_partition = any(
+        m.collectives() for m in pod.modules.values()
+    )
+    journal = None
+    completed: dict[tuple[str, int], dict] = {}
+    healthy_done: dict[str, dict] = {}
+    if out_dir is not None:
+        out_dir = Path(out_dir)
+        journal = Journal(out_dir)
+        if resume:
+            _, records = journal.open_resume(header)
+            for rec in records:
+                if rec.get("kind") == "scenario":
+                    completed[(rec["slice"], rec["index"])] = rec["row"]
+                elif rec.get("kind") == "healthy":
+                    healthy_done[rec["slice"]] = rec["row"]
+        else:
+            journal.open_fresh(header)
+
+    slices_doc: list[dict] = []
+    rows_by_slice: dict[str, list[dict]] = {}
+    try:
+        for sl in spec.slices(default_chips):
+            stats.slices += 1
+            cfg = load_config(
+                arch=sl.arch, overlays=[{"power_enabled": True}],
+                tuned=spec.tuned,
+            )
+            topo = torus_for(sl.chips, cfg.arch.name)
+            healthy = healthy_done.get(sl.label)
+            if healthy is None:
+                cycles, step_s, watts, energy = _price(
+                    pod, cfg, topo, None, cache, workers,
+                )
+                healthy = {
+                    "cycles": cycles, "step_s": step_s,
+                    "watts": watts, "energy_j": energy,
+                }
+                if journal is not None:
+                    journal.append({
+                        "kind": "healthy", "slice": sl.label,
+                        "row": healthy,
+                    })
+            slices_doc.append({
+                "label": sl.label,
+                "arch": sl.arch,
+                "chips": sl.chips,
+                "healthy_cycles": healthy["cycles"],
+                "healthy_step_s": healthy["step_s"],
+                "healthy_watts": healthy.get("watts"),
+                "healthy_energy_j": healthy.get("energy_j"),
+            })
+            rows = rows_by_slice.setdefault(sl.label, [])
+            for i in range(spec.scenarios):
+                stats.scenarios += 1
+                prior = completed.get((sl.label, i))
+                if prior is not None:
+                    stats.resumed += 1
+                    rows.append(prior)
+                    continue
+                row, sched_doc = _run_scenario(
+                    spec, pod, cfg, topo, sl.label, i, healthy, cache,
+                    workers, stats,
+                    replay_chips=min(default_chips, topo.num_chips),
+                    check_partition=check_partition,
+                    sleep=sleep,
+                )
+                if journal is not None:
+                    journal.append({
+                        "kind": "scenario", "slice": sl.label,
+                        "index": i, "schedule": sched_doc, "row": row,
+                    })
+                rows.append(row)
+                if progress is not None:
+                    progress(
+                        f"{sl.label} scenario {i + 1}/{spec.scenarios}: "
+                        f"{row['status']}"
+                    )
+    finally:
+        if journal is not None:
+            journal.close()
+
+    doc = build_report(
+        spec=spec,
+        spec_digest=digest,
+        model_version=header["model_version"],
+        trace_name=trace_name,
+        slices=slices_doc,
+        rows_by_slice=rows_by_slice,
+    )
+    report_path = None
+    if out_dir is not None:
+        report_path = out_dir / "report.json"
+        tmp = report_path.with_suffix(
+            f".tmp.{os.getpid()}"
+        )
+        tmp.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        os.replace(tmp, report_path)
+    return CampaignResult(
+        doc=doc, stats=stats, out_dir=out_dir, report_path=report_path,
+        wall_seconds=time.perf_counter() - t0,
+        rows_by_slice=rows_by_slice,
+    )

@@ -364,9 +364,17 @@ class FaultView:
     def build(cls, topo, bound: list) -> "FaultView":
         self = cls()
         dead: set[tuple[int, int]] = set()
-        scales: dict[tuple[int, int], float] = {}
-        chip_clock: dict[int, float] = {}
-        chip_hbm: dict[int, float] = {}
+        # overlapping same-resource faults stack MULTIPLICATIVELY, and the
+        # product is taken in sorted-scale order: float multiplication is
+        # commutative but not associative, so three 0.x scales composed in
+        # schedule-file order can differ in the last ulp from the same
+        # faults listed in another order.  Generated schedules (the
+        # Monte-Carlo campaign sampler) must price identically however
+        # their records happen to be emitted, so factors are collected
+        # per resource and reduced deterministically.
+        link_factors: dict[tuple[int, int], list[float]] = {}
+        clock_factors: dict[int, list[float]] = {}
+        hbm_factors: dict[int, list[float]] = {}
         for f, where in bound:
             if f.kind == "link_down":
                 a, b = where
@@ -377,11 +385,24 @@ class FaultView:
                 a, b = where
                 pairs = [(a, b)] if f.directed else [(a, b), (b, a)]
                 for p in pairs:
-                    scales[p] = scales.get(p, 1.0) * f.scale
+                    link_factors.setdefault(p, []).append(f.scale)
             elif f.kind == "chip_straggler":
-                chip_clock[where] = chip_clock.get(where, 1.0) * f.scale
+                clock_factors.setdefault(where, []).append(f.scale)
             elif f.kind == "hbm_throttle":
-                chip_hbm[where] = chip_hbm.get(where, 1.0) * f.scale
+                hbm_factors.setdefault(where, []).append(f.scale)
+
+        def _reduce(factors: dict) -> dict:
+            out = {}
+            for k, fs in factors.items():
+                prod = 1.0
+                for s in sorted(fs):
+                    prod *= s
+                out[k] = prod
+            return out
+
+        scales = _reduce(link_factors)
+        chip_clock = _reduce(clock_factors)
+        chip_hbm = _reduce(hbm_factors)
         self.dead = frozenset(dead)
         self.scales = scales
         self.chip_clock = chip_clock

@@ -23,9 +23,12 @@ threads, with terminal results retained for polling.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 __all__ = [
     "AdmissionController",
@@ -233,16 +236,111 @@ class JobTable:
 
     The daemon owns the worker threads; the table only sequences.  A
     full queue raises :class:`Overloaded` — the same backpressure story
-    as the sync path."""
+    as the sync path.
 
-    def __init__(self, queue_depth: int = 16, keep: int = 256):
+    ``persist_dir`` adds crash-safety for the job SPECS: every accepted
+    job writes ``<dir>/<job_id>.json`` (atomic temp + ``os.replace``)
+    and updates it on state transitions, so a restarted daemon
+    re-enqueues whatever was queued or running when the process died —
+    an accepted job id must eventually resolve even across a crash.
+    (Campaign jobs additionally journal their per-scenario progress via
+    :mod:`tpusim.campaign.journal`; requeueing here is what re-enters
+    that resume path.)"""
+
+    def __init__(
+        self, queue_depth: int = 16, keep: int = 256,
+        persist_dir=None, evict_hook=None,
+    ):
         self.queue_depth = max(int(queue_depth), 1)
         self.keep = max(int(keep), 1)
+        #: called with a job_id when a terminal job ages out of `keep`
+        #: — the daemon uses it to reclaim per-job state (campaign
+        #: journal dirs) that would otherwise grow without bound
+        self.evict_hook = evict_hook
         self._cond = threading.Condition()
         self._queue: list[Job] = []
         self._jobs: dict[str, Job] = {}
         self._next_id = 0
         self._draining = False
+        self.persist_dir = Path(persist_dir) if persist_dir else None
+        self.recovered = 0
+        if self.persist_dir is not None:
+            os.makedirs(self.persist_dir, exist_ok=True)
+            self._recover()
+
+    # -- persistence ---------------------------------------------------------
+
+    def _job_path(self, job_id: str):
+        return self.persist_dir / f"{job_id}.json"
+
+    def _persist(self, job: Job) -> None:
+        """Write the job's current state (caller holds the lock)."""
+        if self.persist_dir is None:
+            return
+        doc = {
+            "job_id": job.job_id,
+            "kind": job.kind,
+            "request": job.request,
+            "status": job.status,
+        }
+        if job.result is not None:
+            doc["result"] = job.result
+        if job.error is not None:
+            doc["error"] = job.error
+        path = self._job_path(job.job_id)
+        tmp = path.with_suffix(
+            f".tmp.{os.getpid()}.{threading.get_ident()}"
+        )
+        tmp.write_text(json.dumps(doc, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+
+    def _unpersist(self, job_id: str) -> None:
+        if self.persist_dir is None:
+            return
+        try:
+            self._job_path(job_id).unlink()
+        except OSError:
+            pass
+
+    def _recover(self) -> None:
+        """Reload persisted jobs: terminal ones return to the polling
+        table, queued/RUNNING ones re-enqueue (a job that was mid-run
+        when the daemon died must run again — resumable kinds pick up
+        from their own journal)."""
+        import warnings
+
+        recs = []
+        for path in sorted(self.persist_dir.glob("job-*.json")):
+            try:
+                recs.append(json.loads(path.read_text()))
+            except (OSError, json.JSONDecodeError) as e:
+                warnings.warn(
+                    f"job table: dropping unreadable persisted job "
+                    f"{path.name}: {e}",
+                    RuntimeWarning, stacklevel=2,
+                )
+        for doc in recs:
+            try:
+                job = Job(
+                    job_id=str(doc["job_id"]),
+                    kind=str(doc["kind"]),
+                    request=dict(doc["request"]),
+                    status=str(doc.get("status", "queued")),
+                    result=doc.get("result"),
+                    error=doc.get("error"),
+                )
+                num = int(job.job_id.rsplit("-", 1)[1])
+            except (KeyError, TypeError, ValueError, IndexError):
+                continue
+            self._next_id = max(self._next_id, num)
+            self._jobs[job.job_id] = job
+            if job.status in ("queued", "running"):
+                job.status = "queued"
+                self._queue.append(job)
+                self.recovered += 1
+                self._persist(job)
+
+    # -- sequencing ----------------------------------------------------------
 
     def submit(self, kind: str, request: dict) -> Job:
         with self._cond:
@@ -257,8 +355,18 @@ class JobTable:
             )
             self._queue.append(job)
             self._jobs[job.job_id] = job
-            self._trim()
+            self._persist(job)
+            evicted = self._trim()
             self._cond.notify()
+        # eviction reclaims arbitrary per-job state (a campaign journal
+        # dir can hold thousands of records) — never under the lock,
+        # where it would stall every submit/poll/drain on one rmtree
+        for jid in evicted:
+            if self.evict_hook is not None:
+                try:
+                    self.evict_hook(jid)
+                except Exception:  # noqa: BLE001 - eviction best-effort
+                    pass
         return job
 
     def get(self, job_id: str) -> Job | None:
@@ -274,6 +382,7 @@ class JobTable:
                 return None
             job = self._queue.pop(0)
             job.status = "running"
+            self._persist(job)
             return job
 
     def finish(self, job: Job, result: dict | None, error: str | None) -> None:
@@ -282,6 +391,7 @@ class JobTable:
             job.result = result
             job.error = error
             job.finished_s = time.monotonic()
+            self._persist(job)
             self._cond.notify_all()
 
     def start_drain(self) -> None:
@@ -306,15 +416,21 @@ class JobTable:
                 self._cond.wait(remaining if remaining is not None else 0.5)
         return True
 
-    def _trim(self) -> None:
+    def _trim(self) -> list[str]:
         # retain only the newest `keep` terminal jobs; queued/running
-        # entries are never dropped
+        # entries are never dropped.  Returns the evicted ids — the
+        # caller runs evict_hook on them OUTSIDE the lock.
         terminal = [
             jid for jid, j in self._jobs.items()
             if j.status in ("done", "failed")
         ]
+        evicted: list[str] = []
         while len(terminal) > self.keep:
-            self._jobs.pop(terminal.pop(0), None)
+            dropped = terminal.pop(0)
+            self._jobs.pop(dropped, None)
+            self._unpersist(dropped)
+            evicted.append(dropped)
+        return evicted
 
     def stats_dict(self) -> dict[str, float]:
         with self._cond:

@@ -260,6 +260,14 @@ class ServeClient:
         doc = self._request("POST", "/v1/sweep", request)
         return str(doc["job_id"])
 
+    def campaign(self, **request) -> str:
+        """Submit an async Monte-Carlo campaign (``spec=`` + the usual
+        ``trace=``/``hlo_text=``); returns the job id.  Poll with
+        :meth:`wait_job` — the result is the campaign report
+        document."""
+        doc = self._request("POST", "/v1/campaign", request)
+        return str(doc["job_id"])
+
     def job(self, job_id: str) -> JobStatus:
         doc = self._request("GET", f"/v1/jobs/{job_id}")
         return JobStatus(

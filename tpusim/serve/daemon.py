@@ -12,6 +12,9 @@ route                 verb  backing layer
 ``/v1/simulate``      POST  admission → :meth:`ServeWorker.simulate`
 ``/v1/lint``          POST  admission → :meth:`ServeWorker.lint`
 ``/v1/sweep``         POST  :class:`JobTable` (async; returns job id)
+``/v1/campaign``      POST  :class:`JobTable` (async; crash-safe when
+                            ``--state-dir`` is set — spec persisted,
+                            progress journaled, restart resumes)
 ``/v1/jobs/<id>``     GET   :class:`JobTable`
 ``/v1/traces``        GET   :class:`TraceRegistry`
 ``/healthz``          GET   liveness (503 while draining)
@@ -202,13 +205,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/v1/lint":
             d._count("serve_requests_lint_total")
             self._run_sync("lint", d.worker.lint)
-        elif path == "/v1/sweep":
-            d._count("serve_requests_sweep_total")
+        elif path in ("/v1/sweep", "/v1/campaign"):
+            kind = path.rsplit("/", 1)[1]
+            d._count(f"serve_requests_{kind}_total")
             body = self._read_body()
             if body is None:
                 return
             try:
-                job = d.jobs.submit("sweep", body)
+                job = d.jobs.submit(kind, body)
             except Overloaded as e:
                 d._count("serve_rejected_429_total")
                 self._send_json(429, {
@@ -319,9 +323,12 @@ class ServeDaemon:
         job_workers: int = 1,
         job_queue_depth: int = 16,
         drain_grace_s: float = 60.0,
+        state_dir=None,
         verbose: bool = False,
         work_hook=None,
     ):
+        from pathlib import Path
+
         from tpusim.perf.cache import ResultCache, as_result_cache
 
         self.host = host
@@ -348,12 +355,29 @@ class ServeDaemon:
         self.admission = AdmissionController(
             max_inflight=max_inflight, queue_depth=queue_depth,
         )
-        self.jobs = JobTable(queue_depth=job_queue_depth)
+        # --state-dir makes accepted jobs crash-safe: specs persist
+        # under <state_dir>/jobs (re-enqueued on restart) and campaign
+        # jobs journal per-scenario progress under <state_dir>/campaigns
+        # so a restarted daemon RESUMES them instead of re-pricing
+        self.state_dir = Path(state_dir) if state_dir else None
+        self.jobs = JobTable(
+            queue_depth=job_queue_depth,
+            persist_dir=(
+                self.state_dir / "jobs" if self.state_dir else None
+            ),
+            # reclaim per-job campaign journals when the job ages out
+            # of retention — journals are scenario-grained and fsync'd,
+            # so a long-running daemon would otherwise grow disk
+            # monotonically with every campaign ever run
+            evict_hook=self._evict_job_state,
+        )
 
         self._httpd: ThreadingHTTPServer | None = None
         self._serve_thread: threading.Thread | None = None
         self._job_threads: list[threading.Thread] = []
-        self._job_workers = max(int(job_workers), 1)
+        # 0 is a legitimate (test-facing) value: accept + persist jobs
+        # without draining them — the restart-recovery path in a box
+        self._job_workers = max(int(job_workers), 0)
         self._stop_jobs = threading.Event()
         self._stopped = threading.Event()
         self._counters: dict[str, float] = {}
@@ -444,6 +468,27 @@ class ServeDaemon:
             self._job_threads.append(t)
         return self
 
+    def campaign_dir(self, job_id: str):
+        """Where one campaign job journals (None without --state-dir:
+        the job still runs, it just cannot survive a crash)."""
+        if self.state_dir is None:
+            return None
+        return self.state_dir / "campaigns" / job_id
+
+    def _evict_job_state(self, job_id: str) -> None:
+        d = self.campaign_dir(job_id)
+        if d is not None and d.is_dir():
+            import shutil
+
+            shutil.rmtree(d, ignore_errors=True)
+
+    def _run_job(self, job) -> dict:
+        if job.kind == "campaign":
+            return self.worker.campaign(
+                job.request, out_dir=self.campaign_dir(job.job_id),
+            )
+        return self.worker.sweep(job.request)
+
     def _job_loop(self) -> None:
         while True:
             job = self.jobs.next_job(timeout_s=0.2)
@@ -452,7 +497,7 @@ class ServeDaemon:
                     return
                 continue
             try:
-                result = self.worker.sweep(job.request)
+                result = self._run_job(job)
             except RequestError as e:
                 self.jobs.finish(job, None, f"{e.code}: {e.detail}")
                 self._count("serve_jobs_failed_total")
@@ -482,6 +527,19 @@ class ServeDaemon:
             self._httpd.server_close()
         self._stopped.set()
         return clean
+
+    def abort(self) -> None:
+        """Stop WITHOUT draining — the crash-simulation path (tests,
+        emergency teardown): listener closed, job threads told to stop,
+        queued/running jobs left exactly as persisted so a fresh daemon
+        on the same ``state_dir`` recovers them."""
+        self._stop_jobs.set()
+        for t in self._job_threads:
+            t.join(timeout=2.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self._stopped.set()
 
     def wait_stopped(self, timeout_s: float | None = None) -> bool:
         return self._stopped.wait(timeout_s)

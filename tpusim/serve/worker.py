@@ -122,6 +122,10 @@ class ServeWorker:
         self.model_version = model_version()
         self._config_cache: dict[str, object] = {}
         self._config_lock = threading.Lock()
+        # cumulative campaign-executor accounting across async jobs,
+        # mirrored on /metrics (the campaign_* namespace)
+        self._campaign_totals: dict[str, float] = {}
+        self._campaign_lock = threading.Lock()
 
     # -- shared resolution ---------------------------------------------------
 
@@ -338,6 +342,61 @@ class ServeWorker:
             )
         return result.to_doc()
 
+    def campaign(self, req: dict, out_dir=None) -> dict:
+        """``POST /v1/campaign`` body → the campaign report (runs on a
+        job thread).  ``req['spec']`` is the campaign spec document;
+        the workload is the usual ``trace``/``hlo_text`` pair.  With a
+        daemon ``--state-dir``, ``out_dir`` points at this job's
+        journal directory — a restarted daemon re-enters here and
+        resumes from the last completed scenario instead of re-pricing
+        from zero."""
+        import json as _json
+
+        from tpusim.analysis import ValidationError
+        from tpusim.campaign import (
+            CampaignSpecError, load_campaign_spec, run_campaign,
+        )
+
+        spec_doc = req.get("spec")
+        if not isinstance(spec_doc, dict):
+            raise RequestError(
+                400, "bad_request",
+                "'spec' (a campaign spec object) is required",
+            )
+        try:
+            spec = load_campaign_spec(spec_doc)
+        except CampaignSpecError as e:
+            raise RequestError(
+                400, "bad_campaign_spec", str(e),
+                extra={"codes": [e.code]},
+            )
+        entry, _inline = self._resolve_entry(req)
+        try:
+            result = run_campaign(
+                spec,
+                pod=entry.pod,
+                trace_name=entry.name,
+                out_dir=out_dir,
+                resume=out_dir is not None,
+                result_cache=self.result_cache,
+                workers=self.workers,
+            )
+        except ValidationError as e:
+            raise RequestError(
+                400, "validation_failed",
+                f"campaign spec refused: {e.diags.summary()}",
+                extra={
+                    "codes": sorted(d.code for d in e.diags.errors),
+                    "diagnostics": _json.loads(e.diags.to_json()),
+                },
+            )
+        with self._campaign_lock:
+            for k, v in result.stats.stats_dict().items():
+                self._campaign_totals[k] = (
+                    self._campaign_totals.get(k, 0.0) + v
+                )
+        return result.doc
+
     def _config_for_sweep(self, req: dict):
         """Analytic sweeps have no pod to default the arch from."""
 
@@ -360,4 +419,6 @@ class ServeWorker:
                 out[f"cache_{k}"] = v
         with self._config_lock:
             out["configs_hot"] = len(self._config_cache)
+        with self._campaign_lock:
+            out.update(self._campaign_totals)
         return out
