@@ -624,6 +624,134 @@ def campaign_smoke(update: bool = False) -> dict:
     }
 
 
+#: the advise smoke: a fixed-spec strategy sweep on the llama_tiny
+#: fixture whose ranked report must be BYTE-identical to the committed
+#: golden.  The spec covers every synthesizable family (dp, tp, every
+#: dp x tp factorization, ring-attention sp, pipeline pp, one pinned
+#: composite mesh) on two slice types — 14 cells, comfortably past the
+#: 12-cell acceptance floor — and the dp=4 x tp=2 cell's per-chip
+#: collective count must equal MULTICHIP_r05's 14.  tuned=False like
+#: every golden: the report must not shift when a live run refreshes
+#: the fit.
+ADVISE_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+ADVISE_SMOKE_GOLDEN = GOLDEN_DIR / "advise_smoke.json"
+ADVISE_SMOKE_SPEC = {
+    "name": "ci-advise-smoke",
+    "strategies": ["dp", "tp", "dp_tp", "sp", "pp"],
+    "slices": [{"arch": "v5p", "chips": 8},
+               {"arch": "v5e", "chips": 8}],
+    "meshes": [{"dp": 2, "tp": 2, "pp": 2}],
+    "tuned": False,
+    "slo": {"step_time_ms": 1.0},
+}
+
+
+def advise_smoke(update: bool = False) -> dict:
+    """Sharding-advisor determinism contract (tpusim.advise):
+
+    1. the fixed-spec sweep's ranked report must be byte-identical to
+       the committed golden (regen with ``--advise-smoke --update``
+       after an intended model/transform change);
+    2. a warm second pass through the same shared result cache must
+       execute ZERO engine pricing walks and reproduce the report
+       byte-for-byte;
+    3. the report must carry the contract columns (step_ms, ici_bytes,
+       hbm_resident_gib, watts, slo_ok) on >= 12 ranked cells with a
+       non-null recommendation, and the dp=4 x tp=2 cell must
+       synthesize the 14-collective step MULTICHIP_r05 measured;
+    4. the healthy-path golden matrix must stay byte-identical — an
+       advise sweep must not perturb healthy pricing.
+    Raises on violation."""
+    from tpusim.advise import run_advise
+    from tpusim.perf.cache import ResultCache
+    from tpusim.timing.engine import Engine
+
+    cache = ResultCache()
+    res = run_advise(
+        ADVISE_SMOKE_SPEC,
+        trace_path=FIXTURES / ADVISE_SMOKE_FIXTURE,
+        result_cache=cache,
+    )
+    got = json.dumps(res.doc, indent=1, sort_keys=True) + "\n"
+    if update:
+        GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+        ADVISE_SMOKE_GOLDEN.write_text(got)
+    if not ADVISE_SMOKE_GOLDEN.exists():
+        raise ValueError(
+            f"no advise golden {ADVISE_SMOKE_GOLDEN} "
+            f"(run --advise-smoke --update)"
+        )
+    want = ADVISE_SMOKE_GOLDEN.read_text()
+    if got != want:
+        raise ValueError(
+            "advise smoke: fixed-spec report diverged from the "
+            "committed golden (byte comparison failed) — a timing-"
+            "model or transform change must regen with "
+            "--advise-smoke --update"
+        )
+
+    runs = {"n": 0}
+    orig_run = Engine.run
+
+    def counting_run(self, module):
+        runs["n"] += 1
+        return orig_run(self, module)
+
+    Engine.run = counting_run
+    try:
+        warm = run_advise(
+            ADVISE_SMOKE_SPEC,
+            trace_path=FIXTURES / ADVISE_SMOKE_FIXTURE,
+            result_cache=cache,
+        )
+    finally:
+        Engine.run = orig_run
+    if runs["n"] != 0:
+        raise ValueError(
+            f"advise smoke: warm pass still executed {runs['n']} "
+            f"engine pricing walks (expected 0: every cell's compute "
+            f"module must come from the shared cache)"
+        )
+    if json.dumps(warm.doc, indent=1, sort_keys=True) + "\n" != got:
+        raise ValueError(
+            "advise smoke: warm report diverged from cold"
+        )
+
+    doc = res.doc
+    cells = doc["cells"]
+    if len(cells) < 12:
+        raise ValueError(
+            f"advise smoke: only {len(cells)} ranked cells (>= 12 "
+            f"required by the acceptance contract)"
+        )
+    for col in ("step_ms", "ici_bytes", "hbm_resident_gib", "watts",
+                "slo_ok", "collectives_per_chip"):
+        if any(col not in r for r in cells):
+            raise ValueError(f"advise smoke: cell column {col!r} missing")
+    dp4tp2 = [r for r in cells if r["mesh"] == {"dp": 4, "tp": 2}]
+    if not dp4tp2 or dp4tp2[0]["collectives_per_chip"] != 14:
+        raise ValueError(
+            "advise smoke: dp=4 x tp=2 cell does not synthesize the "
+            "14-collective step MULTICHIP_r05 measured "
+            f"(got {dp4tp2[0]['collectives_per_chip'] if dp4tp2 else 'no cell'})"
+        )
+    if doc["recommendation"] is None:
+        raise ValueError("advise smoke: recommendation is null")
+
+    errors = compare(run_matrix())
+    if errors:
+        raise ValueError(
+            "advise smoke: healthy-path golden matrix diverged:\n  "
+            + "\n  ".join(errors)
+        )
+    return {
+        "cells": len(cells),
+        "feasible": sum(1 for r in cells if r["feasible"]),
+        "recommendation": doc["recommendation"]["cell"],
+        "matrix_configs": len(MATRIX),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -651,6 +779,14 @@ def main(argv: list[str] | None = None) -> int:
                          "docs must be byte-identical to the committed "
                          "CLI goldens, and a warm second pass must "
                          "report cache_hit with zero engine walks")
+    ap.add_argument("--advise-smoke", action="store_true",
+                    help="run the fixed-spec sharding-advisor sweep on "
+                         "the llama_tiny fixture: the ranked report "
+                         "must be byte-identical to the committed "
+                         "golden, a warm pass through the shared cache "
+                         "must run zero engine walks, and the "
+                         "dp=4 x tp=2 cell must synthesize the "
+                         "14-collective MULTICHIP_r05 step")
     ap.add_argument("--campaign-smoke", action="store_true",
                     help="run the fixed-seed 16-scenario Monte-Carlo "
                          "campaign on the llama_tiny fixture: the "
@@ -659,6 +795,20 @@ def main(argv: list[str] | None = None) -> int:
                          "percentiles, capacity table included) and "
                          "the healthy golden matrix must be untouched")
     args = ap.parse_args(argv)
+
+    if args.advise_smoke:
+        try:
+            summary = advise_smoke(update=args.update)
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --advise-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --advise-smoke: OK "
+              f"({summary['cells']} ranked cells byte-identical to the "
+              f"committed report, {summary['feasible']} feasible, "
+              f"recommendation {summary['recommendation']!r}, warm "
+              f"pass zero engine walks, healthy matrix unchanged "
+              f"across {summary['matrix_configs']} configs)")
+        return 0
 
     if args.campaign_smoke:
         try:

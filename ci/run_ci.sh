@@ -37,49 +37,59 @@
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  11. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  11. advise  — sharding-advisor determinism: a fixed-spec strategy
+#                sweep on the llama_tiny fixture must reproduce the
+#                committed ranked report byte-for-byte (step-time/
+#                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
+#                the 14-collective MULTICHIP_r05 step), with a warm
+#                pass running zero engine walks and the healthy golden
+#                matrix untouched
+#  12. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-10
+# Usage:  bash ci/run_ci.sh            # tiers 1-11
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/11] build native ==="
+echo "=== [1/12] build native ==="
 make -C native
 
-echo "=== [2/11] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/12] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/11] unit tests (fast tier) ==="
+echo "=== [3/12] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/11] golden-stat regression sims ==="
+echo "=== [4/12] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/11] obs export smoke (schema-checked) ==="
+echo "=== [5/12] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/11] faults smoke (degraded-pod contract) ==="
+echo "=== [6/12] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/11] trace/config/schedule lint smoke ==="
+echo "=== [7/12] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/11] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/12] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/11] serve smoke (HTTP daemon determinism) ==="
+echo "=== [9/12] serve smoke (HTTP daemon determinism) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [10/11] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [10/12] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
+echo "=== [11/12] advise smoke (sharding-advisor determinism) ==="
+python ci/check_golden.py --advise-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [11/11] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [12/12] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [11/11] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [12/12] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -1,0 +1,475 @@
+"""tpusim.advise — spec validation, the strategy-transform layer,
+determinism, cache sharing, and the serve tier's /v1/advise parity."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.advise import (
+    AdviseSpecError,
+    build_cell_pod,
+    build_profile,
+    load_advise_spec,
+    run_advise,
+    scaled_module,
+)
+from tpusim.advise.runner import enumerate_cells
+from tpusim.ir import CommandKind
+from tpusim.trace.format import load_trace
+
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+LLAMA = FIXTURES / "llama_tiny_tp2dp2"
+
+BASE_SPEC = {
+    "name": "t",
+    "strategies": ["dp", "tp", "dp_tp", "sp", "pp"],
+    "slices": [{"arch": "v5p", "chips": 8}],
+    "tuned": False,
+}
+
+
+@pytest.fixture(scope="module")
+def llama_pod():
+    return load_trace(LLAMA)
+
+
+@pytest.fixture(scope="module")
+def llama_profile(llama_pod):
+    return build_profile(llama_pod)
+
+
+# -- spec validation --------------------------------------------------------
+
+def test_spec_rejects_unknown_field():
+    with pytest.raises(AdviseSpecError) as e:
+        load_advise_spec({"warp_drive": True})
+    assert e.value.code == "TL220"
+
+
+def test_spec_rejects_unknown_strategy():
+    with pytest.raises(AdviseSpecError) as e:
+        load_advise_spec({"strategies": ["dp", "warp"]})
+    assert e.value.code == "TL221"
+
+
+def test_spec_rejects_slo_without_candidates():
+    with pytest.raises(AdviseSpecError) as e:
+        load_advise_spec({"slices": [], "slo": {"step_time_ms": 1.0}})
+    assert e.value.code == "TL224"
+
+
+def test_spec_defaults_slices_from_capture():
+    spec = load_advise_spec({"strategies": ["dp"]})
+    slices = spec.resolved_slices(4)
+    assert [(s.arch, s.chips) for s in slices] == [("v5p", 4), ("v5p", 8)]
+
+
+def test_advise_passes_flag_mesh_and_arch(tmp_path):
+    from tpusim.analysis import analyze_advise_spec
+
+    diags = analyze_advise_spec({
+        "strategies": ["dp"],
+        "slices": [{"arch": "v9z", "chips": 8}],
+        "meshes": [{"dp": 3, "tp": 2}],
+    }, default_chips=8)
+    assert {"TL222", "TL223"} <= diags.codes()
+
+
+def test_run_advise_refuses_bad_spec():
+    from tpusim.analysis import ValidationError
+
+    with pytest.raises(ValidationError):
+        run_advise(
+            dict(BASE_SPEC, meshes=[{"dp": 3, "tp": 2}]),
+            trace_path=LLAMA,
+        )
+
+
+# -- capture profiling ------------------------------------------------------
+
+def test_profile_classifies_llama_capture(llama_profile):
+    p = llama_profile
+    assert (p.chips0, p.dp0, p.tp0) == (4, 2, 2)
+    # 14 collective sites total: 13 tp-role activation all-reduces +
+    # the strided-group gradient all-reduce (dp role)
+    assert len(p.sites) == 14
+    assert len(p.tp_sites) == 13
+    assert len(p.dp_sites) == 1
+    assert not p.ep_sites
+    # the gradient payload (params/tp0) recovers the full footprint
+    assert p.param_bytes_total == p.dp_sites[0].payload_bytes * 2
+
+
+# -- the strategy-transform layer -------------------------------------------
+
+def test_scaled_module_halves_largest_dims(llama_pod, llama_profile):
+    mod = llama_pod.modules[llama_profile.module_name]
+    half = scaled_module(mod, 0.5, "half", llama_profile.capture_fp)
+    # collectives are stripped to free ops
+    assert not half.collectives()
+    assert len(list(half.all_ops())) == len(list(mod.all_ops()))
+    # a known activation shape: f32[4,256,128] -> largest dim halved
+    op = half.entry.op("all-reduce.42")
+    assert op.opcode == "bitcast"
+    assert op.result.shape == (4, 128, 128)
+    # distinct factors get distinct content hashes (cache identity)
+    quarter = scaled_module(mod, 0.25, "q", llama_profile.capture_fp)
+    assert half.meta["content_hash"] != quarter.meta["content_hash"]
+    assert half.meta["platform"] == mod.meta.get("platform")
+
+
+def _per_chip_collectives(pod, device=0):
+    return [
+        c for c in pod.devices[device].commands
+        if c.kind == CommandKind.COLLECTIVE
+    ]
+
+
+def test_dp4tp2_synthesis_matches_multichip_r05(llama_profile):
+    """The dp=4 x tp=2 cell must synthesize the 14-collective step
+    MULTICHIP_r05 priced on the modeled v5p torus (13 tp activation
+    all-reduces + 1 dp gradient all-reduce per chip)."""
+    from tpusim.advise.transform import scaled_module as sm
+
+    compute = sm(
+        load_trace(LLAMA).modules[llama_profile.module_name],
+        0.5, "c", llama_profile.capture_fp,
+    )
+    pod = build_cell_pod(
+        llama_profile, compute, 8, {"dp": 4, "tp": 2},
+    )
+    colls = _per_chip_collectives(pod)
+    assert len(colls) == 14
+    kinds = [c.collective.kind for c in colls]
+    assert kinds.count("all-reduce") == 14
+    # group sizing: 13 tp collectives over groups of 2, 1 dp over 4
+    sizes = sorted(c.collective.group_size for c in colls)
+    assert sizes == [2] * 13 + [4]
+    # dp groups are strided combs, tp groups contiguous blocks
+    dp_cmd = colls[-1]
+    assert dp_cmd.collective.replica_groups == ((0, 2, 4, 6), (1, 3, 5, 7))
+    tp_cmd = colls[0]
+    assert tp_cmd.collective.replica_groups == (
+        (0, 1), (2, 3), (4, 5), (6, 7),
+    )
+
+
+def test_sp_ring_synthesis(llama_profile):
+    from tpusim.advise.transform import scaled_module as sm
+
+    compute = sm(
+        load_trace(LLAMA).modules[llama_profile.module_name],
+        0.5, "c", llama_profile.capture_fp,
+    )
+    pod = build_cell_pod(llama_profile, compute, 8, {"sp": 8})
+    colls = _per_chip_collectives(pod)
+    # 13 sites x (sp - 1) ring permutes + 1 full-pod gradient all-reduce
+    assert len(colls) == 13 * 7 + 1
+    assert sum(
+        1 for c in colls if c.collective.kind == "collective-permute"
+    ) == 91
+    grad = [c for c in colls if c.collective.kind == "all-reduce"]
+    assert len(grad) == 1 and grad[0].collective.group_size == 8
+    # params replicate across sp: gradient payload is tp0-unsharded
+    assert grad[0].nbytes == \
+        llama_profile.dp_sites[0].payload_bytes * llama_profile.tp0
+
+
+def test_dp_sp_composite_synthesis(llama_profile):
+    """A pinned dp=2 x sp=4 mesh must build one sp subring PER dp
+    replica (not one pod-wide ring), rendezvous each chip in its own
+    subring, and sync gradients over the WHOLE pod (params replicate
+    across both axes) at the tp0-unsharded payload."""
+    from tpusim.advise.transform import scaled_module as sm
+
+    compute = sm(
+        load_trace(LLAMA).modules[llama_profile.module_name],
+        0.5, "c", llama_profile.capture_fp,
+    )
+    pod = build_cell_pod(llama_profile, compute, 8, {"dp": 2, "sp": 4})
+    colls = _per_chip_collectives(pod)
+    perms = [
+        c for c in colls if c.collective.kind == "collective-permute"
+    ]
+    assert len(perms) == 13 * 3  # (sp - 1) rotations per tp site
+    # two 4-chip subrings, no cross-replica pair
+    assert perms[0].collective.replica_groups == (
+        (0, 1, 2, 3), (4, 5, 6, 7),
+    )
+    assert all(
+        (a < 4) == (b < 4)
+        for a, b in perms[0].collective.source_target_pairs
+    )
+    # the rotated block is the cell's per-chip activation:
+    # capture payload x dp0 / (dp * sp)
+    site = llama_profile.tp_sites[0]
+    assert perms[0].nbytes == int(site.payload_bytes * 2 / 8)
+    grads = [c for c in colls if c.collective.kind == "all-reduce"]
+    assert len(grads) == 1
+    assert grads[0].collective.replica_groups == (tuple(range(8)),)
+    assert grads[0].nbytes == \
+        llama_profile.dp_sites[0].payload_bytes * llama_profile.tp0
+
+
+def test_unsupported_mesh_combos_are_skipped(llama_pod):
+    from tpusim.advise.runner import _unsupported_combo
+
+    doc = run_advise(
+        {
+            "name": "t", "strategies": ["dp"], "tuned": False,
+            "slices": [{"arch": "v5p", "chips": 8}],
+            "meshes": [{"tp": 2, "sp": 4}, {"sp": 2, "pp": 4}],
+        },
+        pod=llama_pod,
+    ).doc
+    reasons = [s["reason"] for s in doc["skipped"]]
+    assert reasons == ["sp composes with a dp axis only"] * 2
+    # the ep guard, directly (a dense capture skips ep cells earlier,
+    # on the no-expert-sites reason)
+    assert _unsupported_combo({"ep": 2, "pp": 4}) == \
+        "ep composes with a dp axis only"
+    assert _unsupported_combo({"dp": 2, "ep": 4}) is None
+    assert _unsupported_combo({"dp": 2, "tp": 2, "pp": 2}) is None
+
+
+def test_spec_rejects_absurd_slice():
+    with pytest.raises(AdviseSpecError) as e:
+        load_advise_spec({
+            "slices": [{"arch": "v5p", "chips": 1 << 20}],
+        })
+    assert e.value.code == "TL220"
+
+
+def test_pp_pipeline_streams(llama_profile):
+    from tpusim.advise.transform import scaled_module as sm
+
+    compute = sm(
+        load_trace(LLAMA).modules[llama_profile.module_name],
+        1 / 16, "c", llama_profile.capture_fp,
+    )
+    pod = build_cell_pod(
+        llama_profile, compute, 8, {"pp": 8}, launches=8,
+    )
+    # every stage launches one microbatch 8 times
+    for d in range(8):
+        launches = [
+            c for c in pod.devices[d].commands
+            if c.kind == CommandKind.KERNEL_LAUNCH
+        ]
+        assert len(launches) == 8
+    # edge stages permute once per microbatch, interior twice
+    assert len(_per_chip_collectives(pod, 0)) == 8
+    assert len(_per_chip_collectives(pod, 7)) == 8
+    assert len(_per_chip_collectives(pod, 3)) == 16
+    # the hand-off payload is the boundary activation per microbatch
+    c0 = _per_chip_collectives(pod, 0)[0]
+    assert c0.collective.kind == "collective-permute"
+    assert c0.collective.source_target_pairs == ((0, 1),)
+
+
+def test_pipeline_bubble_shows_in_step_time(llama_pod):
+    """A pp cell's step must exceed a dp cell's on the same chip count
+    (the fill/drain bubble the rendezvous reproduces), both pricing
+    the same total work."""
+    doc = run_advise(
+        dict(BASE_SPEC, strategies=["dp", "pp"]),
+        pod=llama_pod,
+    ).doc
+    by_strategy = {r["strategy"]: r for r in doc["cells"]}
+    assert by_strategy["pp"]["step_ms"] > by_strategy["dp"]["step_ms"]
+
+
+def test_ep_without_expert_capture_is_skipped(llama_pod):
+    doc = run_advise(
+        dict(BASE_SPEC, strategies=["dp", "ep"]),
+        pod=llama_pod,
+    ).doc
+    assert len(doc["cells"]) == 1
+    assert len(doc["skipped"]) == 1
+    assert "expert" in doc["skipped"][0]["reason"]
+
+
+# -- ranking / report contract ----------------------------------------------
+
+def test_report_ranks_cells_with_contract_columns(llama_pod):
+    spec = dict(
+        BASE_SPEC,
+        slices=[{"arch": "v5p", "chips": 8}, {"arch": "v5e", "chips": 8}],
+        slo={"step_time_ms": 1.0},
+    )
+    res = run_advise(spec, pod=llama_pod)
+    doc = res.doc
+    assert len(doc["cells"]) >= 12
+    ranks = [r["rank"] for r in doc["cells"]]
+    assert ranks == sorted(ranks)
+    feas = [r["feasible"] for r in doc["cells"]]
+    # feasible cells rank above infeasible ones
+    assert feas == sorted(feas, reverse=True)
+    for r in doc["cells"]:
+        for col in ("step_ms", "ici_bytes", "collectives_per_chip",
+                    "hbm_resident_gib", "watts", "pod_watts",
+                    "perf_per_watt", "slo_ok", "fits_hbm"):
+            assert col in r, col
+        assert r["step_ms"] > 0 and r["watts"] > 0
+    rec = doc["recommendation"]
+    assert rec is not None and rec["cell"] == doc["cells"][0]["cell"]
+    assert res.stats.stats_dict()["advise_cells_priced"] == len(
+        doc["cells"]
+    )
+
+
+def test_slo_flags_infeasible_cells(llama_pod):
+    tight = run_advise(
+        dict(BASE_SPEC, slo={"step_time_ms": 1e-6}), pod=llama_pod,
+    ).doc
+    assert all(r["slo_ok"] is False for r in tight["cells"])
+    assert tight["recommendation"] is None
+
+
+def test_residency_shards_params_over_model_axes():
+    from tpusim.advise.runner import PARAM_STATE_MULT, _residency_gib
+    from tpusim.advise.transform import WorkloadProfile
+
+    # a param-dominated workload: 8 GiB of parameters, no activations
+    prof = WorkloadProfile(
+        module_name="m", chips0=4, dp0=2, tp0=2, sites=(),
+        param_bytes_total=8 << 30, act_boundary_bytes=0,
+        capture_fp="fp",
+    )
+    dp8 = _residency_gib(prof, {"dp": 8})
+    tp8 = _residency_gib(prof, {"tp": 8})
+    # dp replicates the parameter state (weights+grads+opt); tp
+    # shards it 8 ways
+    assert dp8 == pytest.approx(8.0 * PARAM_STATE_MULT)
+    assert tp8 == pytest.approx(dp8 / 8.0)
+
+
+def test_enumerate_cells_dedups_pinned(llama_profile):
+    spec = load_advise_spec({
+        "strategies": ["dp_tp"],
+        "slices": [{"arch": "v5p", "chips": 8}],
+        "meshes": [{"dp": 4, "tp": 2}],   # duplicates an enumerated cell
+    })
+    cells = enumerate_cells(spec, llama_profile.chips0)
+    labels = [c.label for c in cells]
+    assert len(labels) == len(set(labels)) == 2  # dp2xtp4 + dp4xtp2
+
+
+# -- determinism & cache sharing --------------------------------------------
+
+def test_fixed_spec_reports_are_byte_identical(llama_pod):
+    a = run_advise(BASE_SPEC, pod=llama_pod).doc
+    b = run_advise(BASE_SPEC, pod=llama_pod).doc
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_warm_rerun_prices_zero_engine_walks(llama_pod):
+    from tpusim.perf.cache import ResultCache
+    from tpusim.timing.engine import Engine
+
+    cache = ResultCache()
+    cold = run_advise(BASE_SPEC, pod=llama_pod, result_cache=cache)
+    runs = {"n": 0}
+    orig = Engine.run
+
+    def counting(self, module):
+        runs["n"] += 1
+        return orig(self, module)
+
+    Engine.run = counting
+    try:
+        warm = run_advise(BASE_SPEC, pod=llama_pod, result_cache=cache)
+    finally:
+        Engine.run = orig
+    assert runs["n"] == 0
+    assert json.dumps(cold.doc, sort_keys=True) == \
+        json.dumps(warm.doc, sort_keys=True)
+
+
+def test_cells_share_engine_walks_per_scale(llama_pod):
+    """dp/tp/sp cells at the same chip count share ONE compute-module
+    engine walk per arch — the collective-free clone's cache key has
+    no topology component."""
+    from tpusim.timing.engine import Engine
+
+    runs = {"n": 0}
+    orig = Engine.run
+
+    def counting(self, module):
+        runs["n"] += 1
+        return orig(self, module)
+
+    Engine.run = counting
+    try:
+        run_advise(
+            dict(BASE_SPEC, strategies=["dp", "tp", "sp", "dp_tp"]),
+            pod=llama_pod,
+        )
+    finally:
+        Engine.run = orig
+    assert runs["n"] == 1
+
+
+# -- serve tier -------------------------------------------------------------
+
+def test_served_advise_doc_matches_cli(llama_pod):
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    cli_doc = run_advise(BASE_SPEC, trace_path=LLAMA).doc
+    with ServeDaemon(trace_root=FIXTURES) as d:
+        c = ServeClient(d.url)
+        job = c.advise(spec=BASE_SPEC, trace="llama_tiny_tp2dp2")
+        st = c.wait_job(job, timeout_s=120.0)
+        assert st.status == "done", st.error
+        assert json.dumps(st.result, sort_keys=True) == \
+            json.dumps(cli_doc, sort_keys=True)
+        prom = c.metrics_text()
+        assert "tpusim_serve_advise_cells_total" in prom
+
+
+def test_served_advise_rejects_bad_spec():
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    with ServeDaemon(trace_root=FIXTURES) as d:
+        c = ServeClient(d.url)
+        job = c.advise(
+            spec={"strategies": ["warp"]}, trace="llama_tiny_tp2dp2",
+        )
+        st = c.wait_job(job, timeout_s=30.0)
+        assert st.status == "failed"
+        assert "bad_advise_spec" in (st.error or "")
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_advise_prints_table_and_writes_json(tmp_path, capsys):
+    from tpusim.__main__ import main
+
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(BASE_SPEC))
+    out = tmp_path / "report.json"
+    rc = main([
+        "advise", str(spec_path), "--trace", str(LLAMA),
+        "--json", str(out),
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "recommendation:" in text and "step_ms" in text
+    doc = json.loads(out.read_text())
+    assert doc["cells"] and doc["recommendation"]
+
+
+def test_cli_lint_advise_exit_codes(tmp_path):
+    from tpusim.__main__ import main
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(BASE_SPEC))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"strategies": ["warp"]}))
+    assert main(["lint", "--advise", str(good)]) == 0
+    assert main(["lint", "--advise", str(bad)]) == 1

@@ -34,15 +34,18 @@ def test_golden_catches_model_change(matrix_results):
 
 
 def test_golden_files_are_committed():
-    # one stats golden per matrix row, plus the campaign-smoke report
-    # (a different document shape, pinned by --campaign-smoke)
+    # one stats golden per matrix row, plus the campaign-smoke and
+    # advise-smoke reports (different document shapes, pinned by
+    # --campaign-smoke / --advise-smoke)
     goldens = list((REPO / "ci" / "golden").glob("*.json"))
     matrix = [
         g for g in goldens
-        if g != check_golden.CAMPAIGN_SMOKE_GOLDEN
+        if g not in (check_golden.CAMPAIGN_SMOKE_GOLDEN,
+                     check_golden.ADVISE_SMOKE_GOLDEN)
     ]
     assert len(matrix) == len(check_golden.MATRIX)
     assert check_golden.CAMPAIGN_SMOKE_GOLDEN in goldens
+    assert check_golden.ADVISE_SMOKE_GOLDEN in goldens
     for g in matrix:
         data = json.loads(g.read_text())
         assert "sim_cycle" in data

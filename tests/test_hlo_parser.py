@@ -109,6 +109,25 @@ def test_parse_instruction_iota_replica_groups():
     assert op.collective.group_size == 4
 
 
+def test_parse_instruction_transposed_iota_replica_groups():
+    """``[2,2]<=[2,2]T(1,0)`` is XLA's encoding of a MAJOR-mesh-axis
+    collective (e.g. the dp gradient all-reduce of a dp x tp mesh):
+    the transpose yields STRIDED groups, not contiguous ones.  Group
+    membership feeds the replay driver's rendezvous keys and the
+    advise layer's mesh-role classification."""
+    op = parse_instruction(
+        "%ar = f32[64]{0} all-reduce(%x), channel_id=2, "
+        "replica_groups=[2,2]<=[2,2]T(1,0), to_apply=%add"
+    )
+    assert op.collective.replica_groups == ((0, 2), (1, 3))
+    # a larger mesh: dp=4 groups on a dp4 x tp2 device order
+    op = parse_instruction(
+        "%ar2 = f32[64]{0} all-reduce(%x), channel_id=3, "
+        "replica_groups=[2,4]<=[4,2]T(1,0), to_apply=%add"
+    )
+    assert op.collective.replica_groups == ((0, 2, 4, 6), (1, 3, 5, 7))
+
+
 def test_parse_instruction_collective_permute():
     op = parse_instruction(
         "%cp = f32[16]{0} collective-permute(%x), channel_id=3, "

@@ -106,6 +106,14 @@ def _campaign_defect(doc: dict):
     return build
 
 
+def _advise_defect(doc: dict):
+    def build(tmp_path: Path) -> Diagnostics:
+        from tpusim.analysis import analyze_advise_spec
+
+        return analyze_advise_spec(doc, default_chips=8)
+    return build
+
+
 def _statskey_defect(files: dict[str, str], schema: dict | None = None):
     """Seed a miniature repo with the audited layout and run the
     stats-key contract pass against it."""
@@ -313,6 +321,25 @@ ENTRY %main (p0: f32[8]) -> f32[8] {
              {"name": "ghost-bundle", "prob": 0.5,
               "links": [[[0, 0, 0], [2, 0, 0]]]},
          ]},
+    )),
+    ("advise-unknown-field", {"TL220"}, _advise_defect(
+        {"strategies": ["dp"], "warp_drive": True},
+    )),
+    ("advise-unknown-strategy", {"TL221"}, _advise_defect(
+        {"strategies": ["dp", "warp"]},
+    )),
+    ("advise-mesh-not-factoring", {"TL222"}, _advise_defect(
+        {"strategies": ["dp"],
+         "slices": [{"arch": "v5p", "chips": 8}],
+         "meshes": [{"dp": 3, "tp": 2}]},
+    )),
+    ("advise-unknown-arch", {"TL223"}, _advise_defect(
+        {"strategies": ["dp"],
+         "slices": [{"arch": "v9z", "chips": 8}]},
+    )),
+    ("advise-slo-without-candidates", {"TL224"}, _advise_defect(
+        {"strategies": ["dp"], "slices": [],
+         "slo": {"step_time_ms": 1.0}},
     )),
     ("statskey-ownership", {"TL301"}, _statskey_defect({
         "tpusim/timing/engine.py":

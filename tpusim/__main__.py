@@ -458,6 +458,82 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_advise(args: argparse.Namespace) -> int:
+    """Parallelism-strategy sweep & sharding advisor: price the
+    slices x strategies x meshes cross-product of one traced workload
+    through the shared engine-result cache and print the ranked
+    step-time / ICI-bytes / HBM-residency / watts table with the
+    recommended sharding."""
+    from tpusim.advise import AdviseSpecError, run_advise
+    from tpusim.analysis import ValidationError
+
+    progress = None
+    if args.verbose:
+        def progress(msg: str) -> None:
+            print(f"  {msg}", file=sys.stderr)
+    try:
+        res = run_advise(
+            args.spec,
+            trace_path=args.trace,
+            result_cache=args.result_cache,
+            workers=args.workers,
+            progress=progress,
+        )
+    except AdviseSpecError as e:
+        print(f"tpusim advise: spec refused ({e.code}): {e}",
+              file=sys.stderr)
+        return 1
+    except ValidationError as e:
+        print(f"tpusim advise: spec refused:\n{e}", file=sys.stderr)
+        return 1
+    doc = res.doc
+    cap = doc["capture"]
+    print(f"tpusim advise: {doc['advise']!r} spec={doc['spec_hash']} "
+          f"trace={doc['trace']}")
+    print(f"  capture: {cap['chips']} chips (dp={cap['dp']} "
+          f"tp={cap['tp']}), {cap['collective_sites']['tp']} tp / "
+          f"{cap['collective_sites']['dp']} dp / "
+          f"{cap['collective_sites']['ep']} ep collective sites")
+    header = (f"  {'#':>3s} {'cell':26s} {'strategy':8s} "
+              f"{'step_ms':>9s} {'ici_mb':>8s} {'coll':>5s} "
+              f"{'hbm_gib':>8s} {'watts':>7s} {'pf/W':>7s} flags")
+    print(header)
+    shown = doc["cells"][: args.top] if args.top else doc["cells"]
+    for r in shown:
+        flags = []
+        if not r["fits_hbm"]:
+            flags.append("OOM")
+        if r["slo_ok"] is False:
+            flags.append("SLO-MISS")
+        elif r["slo_ok"] is True:
+            flags.append("slo-ok")
+        w = f"{r['watts']:.1f}" if r["watts"] is not None else "-"
+        pw = (f"{r['perf_per_watt']:.4f}"
+              if r["perf_per_watt"] is not None else "-")
+        print(f"  {r['rank']:3d} {r['cell']:26s} {r['strategy']:8s} "
+              f"{r['step_ms']:9.4f} {r['ici_bytes'] / 1e6:8.2f} "
+              f"{r['collectives_per_chip']:5d} "
+              f"{r['hbm_resident_gib']:8.4f} {w:>7s} {pw:>7s} "
+              f"{','.join(flags) or 'ok'}")
+    for s in doc["skipped"]:
+        print(f"      {s['cell']:26s} skipped: {s['reason']}")
+    rec = doc["recommendation"]
+    if rec is not None:
+        print(f"  recommendation: {rec['cell']} "
+              f"({rec['strategy']}, mesh {rec['mesh']}) at "
+              f"{rec['step_ms']:.4f}ms/step")
+    else:
+        print("  recommendation: NONE (no feasible cell)")
+    for k, v in res.stats.stats_dict().items():
+        print(f"  {k} = {v:.0f}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"  report written to {args.json}")
+    return 0
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     """Long-running simulation service (tpusim.serve): JSON API over
     HTTP with hot traces, admission control, a process-wide shared
@@ -537,9 +613,10 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         for line in list_code_lines():
             print(line)
         return 0
-    if args.trace is None and not args.stats_keys and not args.campaign:
+    if args.trace is None and not args.stats_keys and not args.campaign \
+            and not args.advise:
         print("tpusim lint: nothing to analyze — pass a trace dir, "
-              "--campaign, --stats-keys, or --list-codes",
+              "--campaign, --advise, --stats-keys, or --list-codes",
               file=sys.stderr)
         return 2
     if args.trace is None and (args.faults or args.config or args.arch):
@@ -554,20 +631,27 @@ def _cmd_lint(args: argparse.Namespace) -> int:
             args.trace, arch=args.arch, overlays=list(args.config or []),
             faults=args.faults, diags=diags,
         )
-    if args.campaign:
-        from tpusim.analysis import analyze_campaign_spec
-
+    if args.campaign or args.advise:
         default_chips = 1
         if args.trace is not None:
-            # size the primary slice the way the campaign runner would
+            # size the primary slice the way the runners would
             from tpusim.analysis.trace_passes import load_parsed_trace
 
             default_chips = max(
                 load_parsed_trace(args.trace).replay_devices, 1
             )
-        analyze_campaign_spec(
-            args.campaign, diags=diags, default_chips=default_chips,
-        )
+        if args.campaign:
+            from tpusim.analysis import analyze_campaign_spec
+
+            analyze_campaign_spec(
+                args.campaign, diags=diags, default_chips=default_chips,
+            )
+        if args.advise:
+            from tpusim.analysis import analyze_advise_spec
+
+            analyze_advise_spec(
+                args.advise, diags=diags, default_chips=default_chips,
+            )
     if args.stats_keys:
         analyze_stats_keys(diags=diags)
 
@@ -1107,6 +1191,35 @@ def main(argv: list[str] | None = None) -> int:
                      help="per-scenario progress on stderr")
     pcm.set_defaults(fn=_cmd_campaign)
 
+    pad = sub.add_parser(
+        "advise",
+        help="parallelism-strategy sweep & sharding advisor: price the "
+             "slices x strategies x meshes cross-product of one traced "
+             "workload on modeled tori -> ranked step-time/ICI-bytes/"
+             "HBM/watts table + recommended sharding",
+    )
+    pad.add_argument("spec", help="advise spec JSON (see "
+                                  "docs/ARCHITECTURE.md)")
+    pad.add_argument("--trace", required=True,
+                     help="trace directory of the workload to advise on")
+    pad.add_argument("--top", type=int, default=0,
+                     help="print only the best N cells (0 = all)")
+    pad.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="fan each cell's module pricing over N "
+                          "processes (cells run serially so the report "
+                          "is byte-identical)")
+    pad.add_argument("--result-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="share the engine-result cache on disk "
+                          "(in-memory sharing across cells is always "
+                          "on; this persists it — a warm re-run prices "
+                          "zero engine walks)")
+    pad.add_argument("--json", default=None,
+                     help="also write the ranked report document here")
+    pad.add_argument("--verbose", action="store_true",
+                     help="per-cell progress on stderr")
+    pad.set_defaults(fn=_cmd_advise)
+
     psv = sub.add_parser(
         "serve",
         help="simulation-as-a-service daemon: JSON API (simulate/lint/"
@@ -1204,6 +1317,12 @@ def main(argv: list[str] | None = None) -> int:
                           "format, candidate slices, SLO percentile, "
                           "correlated-group links); works with or "
                           "without a trace dir")
+    pli.add_argument("--advise", default=None, metavar="SPEC.json",
+                     help="advise spec to validate (TL22x codes: "
+                          "format, unknown strategy, mesh "
+                          "factorization, arch presets, SLO without "
+                          "candidates); works with or without a "
+                          "trace dir")
     pli.add_argument("--format", choices=["text", "json"],
                      default="text",
                      help="diagnostic output format (json is the "

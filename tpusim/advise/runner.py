@@ -1,0 +1,399 @@
+"""The advise sweep executor: enumerate cells, price, rank.
+
+One cell = (slice, strategy, mesh degrees).  Cells price serially in
+spec order through ONE shared :class:`tpusim.perf.ResultCache`; the
+synthesized compute modules are collective-free, so every cell with the
+same per-chip shape scale shares one engine walk per arch (a 12-cell
+sweep typically runs a handful of engine walks cold and ZERO warm —
+CI-enforced by ``ci/check_golden.py --advise-smoke``).  The report
+document is a pure function of the priced rows: fixed spec + fixed
+capture -> byte-identical doc.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tpusim.advise.spec import (
+    AdviseSpec,
+    SliceSpec,
+    load_advise_spec,
+    spec_hash,
+)
+from tpusim.advise.transform import (
+    WorkloadProfile,
+    build_cell_pod,
+    build_profile,
+    scaled_module,
+)
+
+__all__ = ["ADVISE_FORMAT_VERSION", "AdviseResult", "AdviseStats",
+           "run_advise"]
+
+ADVISE_FORMAT_VERSION = 1
+
+#: optimizer-state multiplier for the per-chip HBM residency estimate:
+#: a training step holds weights + gradients + one optimizer moment
+#: class alongside them (the capture's train step does exactly this)
+PARAM_STATE_MULT = 3.0
+
+
+@dataclass
+class AdviseStats:
+    """Executor accounting — the ``advise_*`` stats namespace
+    (registered in :mod:`tpusim.analysis.statskeys`).  Rides reports
+    and ``/metrics`` only when an advise sweep actually ran — the
+    healthy simulate path never stamps them."""
+
+    slices: int = 0
+    cells: int = 0
+    priced: int = 0
+    skipped: int = 0
+    feasible: int = 0
+
+    def stats_dict(self) -> dict[str, float]:
+        return {
+            "advise_slices_total": self.slices,
+            "advise_cells_total": self.cells,
+            "advise_cells_priced": self.priced,
+            "advise_cells_skipped": self.skipped,
+            "advise_cells_feasible": self.feasible,
+        }
+
+
+@dataclass
+class AdviseResult:
+    """One advise sweep's report document + executor accounting."""
+
+    doc: dict
+    stats: AdviseStats
+    wall_seconds: float = 0.0
+    profile: WorkloadProfile | None = field(default=None, repr=False)
+
+
+# ---------------------------------------------------------------------------
+# Cell enumeration
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _Cell:
+    sl: SliceSpec
+    strategy: str
+    degrees: tuple[tuple[str, int], ...]
+
+    @property
+    def mesh(self) -> dict[str, int]:
+        return {k: v for k, v in self.degrees if v > 1} or {"dp": 1}
+
+    @property
+    def label(self) -> str:
+        mesh = "x".join(
+            f"{k}{v}" for k, v in self.degrees if v > 1
+        ) or "dp1"
+        return f"{self.sl.label}/{mesh}"
+
+
+def _strategy_meshes(strategy: str, chips: int) \
+        -> list[tuple[tuple[str, int], ...]]:
+    if strategy == "dp_tp":
+        out = []
+        for dp in range(2, chips):
+            if chips % dp == 0 and chips // dp >= 2:
+                out.append((("dp", dp), ("tp", chips // dp)))
+        return out
+    return [((strategy, chips),)]
+
+
+def enumerate_cells(
+    spec: AdviseSpec, default_chips: int,
+) -> list[_Cell]:
+    """The sweep's cross-product, in spec order (slices outer,
+    strategies inner, pinned meshes last per slice) — the doc's cell
+    ordering before ranking, so fixed specs enumerate identically."""
+    cells: list[_Cell] = []
+    seen: set[tuple[str, tuple[tuple[str, int], ...]]] = set()
+
+    def add(sl: SliceSpec, strategy: str,
+            degrees: tuple[tuple[str, int], ...]) -> None:
+        key = (sl.label, degrees)
+        if key in seen:
+            return
+        seen.add(key)
+        cells.append(_Cell(sl=sl, strategy=strategy, degrees=degrees))
+
+    for sl in spec.resolved_slices(default_chips):
+        for strategy in spec.strategies:
+            for degrees in _strategy_meshes(strategy, sl.chips):
+                add(sl, strategy, degrees)
+        for mesh in spec.meshes:
+            if mesh.product == sl.chips:
+                add(sl, "pinned", mesh.axes)
+    return cells
+
+
+# ---------------------------------------------------------------------------
+# Pricing
+# ---------------------------------------------------------------------------
+
+
+def _residency_gib(
+    profile: WorkloadProfile, degrees: dict[str, int],
+) -> float:
+    """Per-chip HBM residency estimate (GiB): the parameter state
+    shards over the model axes (tp, pp, ep) and replicates over the
+    batch axes; activations shard over batch/sequence/stage and
+    replicate over tp.  An estimate by construction — the advisor's
+    fits-HBM flag, not a memory simulator."""
+    tp = degrees.get("tp", 1)
+    pp = degrees.get("pp", 1)
+    ep = degrees.get("ep", 1)
+    dp = degrees.get("dp", 1)
+    sp = degrees.get("sp", 1)
+    params = (
+        profile.param_bytes_total * PARAM_STATE_MULT
+        / max(tp * pp * ep, 1)
+    )
+    act_total = sum(
+        s.payload_bytes for s in profile.tp_sites
+    ) * profile.dp0
+    acts = act_total / max(dp * sp * pp, 1)
+    return (params + acts) / float(1 << 30)
+
+
+def run_advise(
+    spec_src,
+    trace_path: str | Path | None = None,
+    pod=None,
+    trace_name: str | None = None,
+    result_cache=None,
+    workers: int | None = None,
+    validate: bool = True,
+    progress=None,
+) -> AdviseResult:
+    """Execute one advise sweep end to end.
+
+    ``spec_src`` is whatever :func:`~tpusim.advise.spec.
+    load_advise_spec` accepts.  The workload comes from ``trace_path``
+    or an already-parsed ``pod`` (the serve tier passes its hot
+    registry entry).  ``result_cache`` is shared across every cell
+    (None = fresh in-memory cache); ``workers`` fans each replay's
+    module pricing.  ``validate`` runs the TL22x advise passes first
+    and refuses on errors — a broken spec must fail before cell 0
+    prices."""
+    from tpusim.ici.topology import torus_for
+    from tpusim.perf.cache import ResultCache, as_result_cache
+    from tpusim.sim.driver import SimDriver
+    from tpusim.timing.config import load_config
+    from tpusim.timing.model_version import model_version
+
+    t0 = time.perf_counter()
+    spec = load_advise_spec(spec_src)
+    if pod is None:
+        if trace_path is None:
+            raise ValueError("run_advise needs trace_path or pod")
+        from tpusim.trace.format import load_trace
+
+        pod = load_trace(trace_path)
+    if trace_name is None:
+        trace_name = (
+            Path(trace_path).name if trace_path is not None
+            else str(pod.meta.get("name", "inline"))
+        )
+    profile = build_profile(pod)
+
+    if validate:
+        from tpusim.analysis import ValidationError
+        from tpusim.analysis.advise_passes import run_advise_passes
+        from tpusim.analysis.diagnostics import Diagnostics
+
+        diags = Diagnostics()
+        run_advise_passes(spec, diags, default_chips=profile.chips0)
+        if diags.has_errors:
+            raise ValidationError(diags)
+
+    stats = AdviseStats()
+    cache = as_result_cache(result_cache) or ResultCache()
+    cells = enumerate_cells(spec, profile.chips0)
+    dropped = max(len(cells) - spec.max_cells, 0)
+    cells = cells[: spec.max_cells]
+
+    cfg_cache: dict[str, object] = {}
+    module_cache: dict[tuple[str, float], object] = {}
+    rows: list[dict] = []
+    skipped: list[dict] = []
+    for cell in cells:
+        stats.cells += 1
+        degrees = dict(cell.degrees)
+        if degrees.get("ep", 1) > 1 and not profile.ep_sites:
+            stats.skipped += 1
+            skipped.append({
+                "cell": cell.label,
+                "strategy": cell.strategy,
+                "reason": "capture has no expert-parallel (all-to-all) "
+                          "collectives to re-shard",
+            })
+            continue
+        unsupported = _unsupported_combo(degrees)
+        if unsupported is not None:
+            stats.skipped += 1
+            skipped.append({
+                "cell": cell.label,
+                "strategy": cell.strategy,
+                "reason": unsupported,
+            })
+            continue
+
+        cfg = cfg_cache.get(cell.sl.arch)
+        if cfg is None:
+            cfg = cfg_cache[cell.sl.arch] = load_config(
+                arch=cell.sl.arch,
+                overlays=[{"power_enabled": True}],
+                tuned=spec.tuned,
+            )
+        pp = degrees.get("pp", 1)
+        launches = (spec.microbatches or pp) if pp > 1 else 1
+        elem_factor = profile.chips0 / float(cell.sl.chips * launches)
+        mkey = (profile.module_name, elem_factor)
+        compute = module_cache.get(mkey)
+        if compute is None:
+            compute = module_cache[mkey] = scaled_module(
+                pod.modules[profile.module_name], elem_factor,
+                f"{profile.module_name}__advise_{elem_factor!r}",
+                profile.capture_fp,
+            )
+        cell_pod = build_cell_pod(
+            profile, compute, cell.sl.chips, degrees, launches=launches,
+        )
+        from tpusim.ir import CommandKind
+
+        # one device's synthesized collective count — the MULTICHIP
+        # dryrun convention ("14 collectives" in MULTICHIP_r05 is one
+        # chip's dp=4 x tp=2 step, not the pod total)
+        coll_per_chip = sum(
+            1 for c in cell_pod.devices[0].commands
+            if c.kind == CommandKind.COLLECTIVE
+        )
+        topo = torus_for(cell.sl.chips, cfg.arch.name)
+        report = SimDriver(
+            cfg, topology=topo, result_cache=cache, workers=workers,
+        ).run(cell_pod)
+        stats.priced += 1
+
+        clock_hz = cfg.arch.clock_hz
+        step_ms = report.cycles / clock_hz * 1e3 if clock_hz else 0.0
+        watts = energy = None
+        if report.power is not None:
+            watts = report.power.avg_watts
+            energy = report.power.total_joules
+        resident_gib = _residency_gib(profile, degrees)
+        fits_hbm = resident_gib <= cfg.arch.hbm_gib
+        slo_ok = (
+            None if spec.slo is None
+            else step_ms <= spec.slo.step_time_ms
+        )
+        row = {
+            "cell": cell.label,
+            "arch": cell.sl.arch,
+            "chips": cell.sl.chips,
+            "strategy": cell.strategy,
+            "mesh": cell.mesh,
+            "launches": launches,
+            "step_ms": step_ms,
+            "step_cycles": report.cycles,
+            "ici_bytes": report.totals.ici_bytes,
+            "collectives": report.totals.collective_count,
+            "collectives_per_chip": coll_per_chip,
+            "hbm_resident_gib": resident_gib,
+            "fits_hbm": fits_hbm,
+            "watts": watts,
+            "pod_watts": (
+                watts * cell.sl.chips if watts is not None else None
+            ),
+            "perf_per_watt": (
+                (1e3 / step_ms) / (watts * cell.sl.chips)
+                if watts and step_ms > 0 else None
+            ),
+            "energy_j": energy,
+            "slo_ok": slo_ok,
+            "feasible": fits_hbm and slo_ok is not False,
+        }
+        rows.append(row)
+        if row["feasible"]:
+            stats.feasible += 1
+        if progress is not None:
+            progress(
+                f"{cell.label}: {step_ms:.3f}ms "
+                f"({'ok' if row['feasible'] else 'infeasible'})"
+            )
+    stats.slices = len({c.sl.label for c in cells})
+
+    ranked = sorted(
+        rows, key=lambda r: (not r["feasible"], r["step_ms"], r["cell"]),
+    )
+    for i, r in enumerate(ranked):
+        r["rank"] = i + 1
+    recommendation = next((r for r in ranked if r["feasible"]), None)
+
+    doc = {
+        "format_version": ADVISE_FORMAT_VERSION,
+        "advise": spec.name,
+        "spec_hash": spec_hash(spec),
+        "model_version": model_version(),
+        "trace": trace_name,
+        "capture": {
+            "module": profile.module_name,
+            "chips": profile.chips0,
+            "dp": profile.dp0,
+            "tp": profile.tp0,
+            "collective_sites": {
+                "tp": len(profile.tp_sites),
+                "dp": len(profile.dp_sites),
+                "ep": len(profile.ep_sites),
+            },
+            "param_bytes": profile.param_bytes_total,
+        },
+        "slo": (
+            {"step_time_ms": spec.slo.step_time_ms}
+            if spec.slo is not None else None
+        ),
+        "cells": ranked,
+        "skipped": skipped,
+        "cells_dropped": dropped,
+        "recommendation": (
+            {
+                "cell": recommendation["cell"],
+                "strategy": recommendation["strategy"],
+                "mesh": recommendation["mesh"],
+                "step_ms": recommendation["step_ms"],
+            }
+            if recommendation is not None else None
+        ),
+    }
+    return AdviseResult(
+        doc=doc, stats=stats,
+        wall_seconds=time.perf_counter() - t0,
+        profile=profile,
+    )
+
+
+def _unsupported_combo(degrees: dict[str, int]) -> str | None:
+    """Reason string when the transform cannot synthesize this mesh
+    combination, else None.  Supported composites: any subset of
+    {dp, tp, pp}, plus dp x sp and dp x ep — sp/ep never combine with
+    tp, pp, or each other (the synthesized chip layouts would
+    conflict).  Enumerated strategies are always single-axis or
+    dp x tp, so only pinned meshes can land here."""
+    sp = degrees.get("sp", 1)
+    ep = degrees.get("ep", 1)
+    if sp > 1 and (
+        degrees.get("tp", 1) > 1 or degrees.get("pp", 1) > 1 or ep > 1
+    ):
+        return "sp composes with a dp axis only"
+    if ep > 1 and (
+        degrees.get("tp", 1) > 1 or degrees.get("pp", 1) > 1
+    ):
+        return "ep composes with a dp axis only"
+    return None

@@ -17,6 +17,7 @@ from tpusim.analysis.diagnostics import (
     Severity,
     list_code_lines,
 )
+from tpusim.analysis.advise_passes import analyze_advise_spec
 from tpusim.analysis.campaign_passes import analyze_campaign_spec
 from tpusim.analysis.runner import (
     ValidationError,
@@ -35,6 +36,7 @@ __all__ = [
     "Severity",
     "STATS_NAMESPACES",
     "ValidationError",
+    "analyze_advise_spec",
     "analyze_campaign_spec",
     "analyze_config",
     "analyze_schedule",

@@ -115,6 +115,15 @@ _code("TL212", _E, "campaign SLO percentile outside (0, 100]")
 _code("TL213", _E, "campaign correlated group references links or axes "
                    "absent from the slice torus")
 
+# --- advise passes (TL22x) -------------------------------------------------
+_code("TL220", _E, "advise spec fails format validation (bad field, "
+                   "type, or range)")
+_code("TL221", _E, "advise spec names an unknown parallelism strategy")
+_code("TL222", _E, "pinned mesh shape does not factor any candidate "
+                   "slice's chip count")
+_code("TL223", _E, "advise candidate slice names an arch with no preset")
+_code("TL224", _E, "advise SLO given without candidate slices to rank")
+
 # --- stats-key contract (TL3xx) --------------------------------------------
 _code("TL301", _E, "stats key written outside its namespace's owning "
                    "subsystem")

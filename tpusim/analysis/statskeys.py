@@ -43,10 +43,12 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     ),
     # the interconnect field family is shared by design: the engine
     # accumulates ici_bytes, the sampler carries the lane, the exports
-    # derive ici_occupancy/ici_gbps tracks
+    # derive ici_occupancy/ici_gbps tracks; the advisor's report rows
+    # and the CLI's ranked table carry the same ici_bytes meaning
+    # verbatim (one name, one meaning, more surfaces)
     "ici_": (
         "tpusim/ici/", "tpusim/obs/", "tpusim/timing/engine.py",
-        "tpusim/sim/driver.py",
+        "tpusim/sim/driver.py", "tpusim/advise/", "tpusim/__main__.py",
     ),
     # the performance layer (PR 4): result-cache effectiveness
     # (hits/misses/evictions + disk tier) — stamped by the driver only
@@ -76,6 +78,15 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     # them on /metrics for async campaign jobs
     "campaign_": (
         "tpusim/campaign/", "tpusim/serve/", "tpusim/__main__.py",
+        "ci/check_golden.py",
+    ),
+    # the sharding advisor (PR 7): strategy-sweep executor accounting
+    # (cells priced/skipped/feasible) — stamped only when an advise
+    # sweep actually ran (the faults_* discipline: healthy simulate
+    # reports never carry them); tpusim.serve mirrors the totals on
+    # /metrics for async advise jobs
+    "advise_": (
+        "tpusim/advise/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
 }
@@ -117,6 +128,7 @@ AUDIT_GLOBS = (
     "tpusim/perf/*.py",
     "tpusim/serve/*.py",
     "tpusim/campaign/*.py",
+    "tpusim/advise/*.py",
     "tpusim/timing/engine.py",
 )
 

@@ -268,6 +268,14 @@ class ServeClient:
         doc = self._request("POST", "/v1/campaign", request)
         return str(doc["job_id"])
 
+    def advise(self, **request) -> str:
+        """Submit an async sharding-advisor sweep (``spec=`` + the
+        usual ``trace=``/``hlo_text=``); returns the job id.  Poll
+        with :meth:`wait_job` — the result is the ranked advise
+        report document."""
+        doc = self._request("POST", "/v1/advise", request)
+        return str(doc["job_id"])
+
     def job(self, job_id: str) -> JobStatus:
         doc = self._request("GET", f"/v1/jobs/{job_id}")
         return JobStatus(

@@ -15,6 +15,8 @@ route                 verb  backing layer
 ``/v1/campaign``      POST  :class:`JobTable` (async; crash-safe when
                             ``--state-dir`` is set — spec persisted,
                             progress journaled, restart resumes)
+``/v1/advise``        POST  :class:`JobTable` (async; the sharding
+                            advisor's ranked strategy-sweep report)
 ``/v1/jobs/<id>``     GET   :class:`JobTable`
 ``/v1/traces``        GET   :class:`TraceRegistry`
 ``/healthz``          GET   liveness (503 while draining)
@@ -205,7 +207,7 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/v1/lint":
             d._count("serve_requests_lint_total")
             self._run_sync("lint", d.worker.lint)
-        elif path in ("/v1/sweep", "/v1/campaign"):
+        elif path in ("/v1/sweep", "/v1/campaign", "/v1/advise"):
             kind = path.rsplit("/", 1)[1]
             d._count(f"serve_requests_{kind}_total")
             body = self._read_body()
@@ -487,6 +489,11 @@ class ServeDaemon:
             return self.worker.campaign(
                 job.request, out_dir=self.campaign_dir(job.job_id),
             )
+        if job.kind == "advise":
+            # no journal: an advise sweep is cache-warm cheap, so a
+            # recovered job simply re-prices (byte-identical by the
+            # determinism contract)
+            return self.worker.advise(job.request)
         return self.worker.sweep(job.request)
 
     def _job_loop(self) -> None:

@@ -122,10 +122,10 @@ class ServeWorker:
         self.model_version = model_version()
         self._config_cache: dict[str, object] = {}
         self._config_lock = threading.Lock()
-        # cumulative campaign-executor accounting across async jobs,
-        # mirrored on /metrics (the campaign_* namespace)
-        self._campaign_totals: dict[str, float] = {}
-        self._campaign_lock = threading.Lock()
+        # cumulative async-job executor accounting (campaign_* and
+        # advise_* namespaces), mirrored on /metrics
+        self._job_totals: dict[str, float] = {}
+        self._job_lock = threading.Lock()
 
     # -- shared resolution ---------------------------------------------------
 
@@ -390,12 +390,60 @@ class ServeWorker:
                     "diagnostics": _json.loads(e.diags.to_json()),
                 },
             )
-        with self._campaign_lock:
-            for k, v in result.stats.stats_dict().items():
-                self._campaign_totals[k] = (
-                    self._campaign_totals.get(k, 0.0) + v
-                )
+        self._accumulate(result.stats.stats_dict())
         return result.doc
+
+    def advise(self, req: dict) -> dict:
+        """``POST /v1/advise`` body → the ranked advisor report (runs
+        on a job thread).  ``req['spec']`` is the advise spec document;
+        the workload is the usual ``trace``/``hlo_text`` pair.  The
+        served doc is byte-identical to the ``tpusim advise`` CLI's —
+        cells price through the same shared result cache."""
+        import json as _json
+
+        from tpusim.advise import (
+            AdviseSpecError, load_advise_spec, run_advise,
+        )
+        from tpusim.analysis import ValidationError
+
+        spec_doc = req.get("spec")
+        if not isinstance(spec_doc, dict):
+            raise RequestError(
+                400, "bad_request",
+                "'spec' (an advise spec object) is required",
+            )
+        try:
+            spec = load_advise_spec(spec_doc)
+        except AdviseSpecError as e:
+            raise RequestError(
+                400, "bad_advise_spec", str(e),
+                extra={"codes": [e.code]},
+            )
+        entry, _inline = self._resolve_entry(req)
+        try:
+            result = run_advise(
+                spec,
+                pod=entry.pod,
+                trace_name=entry.name,
+                result_cache=self.result_cache,
+                workers=self.workers,
+            )
+        except ValidationError as e:
+            raise RequestError(
+                400, "validation_failed",
+                f"advise spec refused: {e.diags.summary()}",
+                extra={
+                    "codes": sorted(d.code for d in e.diags.errors),
+                    "diagnostics": _json.loads(e.diags.to_json()),
+                },
+            )
+        self._accumulate(result.stats.stats_dict())
+        return result.doc
+
+    def _accumulate(self, stats: dict[str, float]) -> None:
+        with self._job_lock:
+            for k, v in stats.items():
+                self._job_totals[k] = self._job_totals.get(k, 0.0) + v
 
     def _config_for_sweep(self, req: dict):
         """Analytic sweeps have no pod to default the arch from."""
@@ -419,6 +467,6 @@ class ServeWorker:
                 out[f"cache_{k}"] = v
         with self._config_lock:
             out["configs_hot"] = len(self._config_cache)
-        with self._campaign_lock:
-            out.update(self._campaign_totals)
+        with self._job_lock:
+            out.update(self._job_totals)
         return out

@@ -180,23 +180,57 @@ _CALLED_KEYS = (
 
 _REPLICA_GROUPS_IOTA_RE = re.compile(
     r"\[(?P<dims>[0-9,]+)\]<=\[(?P<total>[0-9,]+)\]"
+    r"(?:T\((?P<perm>[0-9,]+)\))?"
 )
 
 
 def _parse_replica_groups(val: str) -> tuple[tuple[int, ...], ...]:
-    """Parse ``{{0,1},{2,3}}`` or iota form ``[2,2]<=[4]`` (optionally with a
-    transpose suffix, ignored for sizing purposes beyond group structure)."""
+    """Parse ``{{0,1},{2,3}}`` or iota form ``[2,2]<=[4]``.
+
+    The iota form may carry a transpose suffix — ``[2,2]<=[2,2]T(1,0)``
+    reshapes ``[0..4)`` to a 2x2 grid, transposes it, and reads groups
+    along the last dim, yielding the STRIDED groups ``{0,2},{1,3}``
+    (how XLA encodes a major-mesh-axis collective, e.g. the dp gradient
+    all-reduce of a dp x tp mesh).  Group membership matters: the
+    rendezvous keys of the replay driver and the mesh-axis role
+    classification of ``tpusim.advise`` both read it."""
     val = val.strip()
     m = _REPLICA_GROUPS_IOTA_RE.match(val)
     if m:
         dims = [int(x) for x in m.group("dims").split(",")]
+        reshape = [int(x) for x in m.group("total").split(",")]
         total = 1
-        for x in m.group("total").split(","):
-            total *= int(x)
+        for x in reshape:
+            total *= x
+        ids = list(range(total))
+        perm = m.group("perm")
+        if perm is not None and len(reshape) > 1:
+            # reshape to `reshape`, transpose by perm, then flatten:
+            # out[j] = ids at the source multi-index perm-mapped from j
+            axes = [int(x) for x in perm.split(",")]
+            if sorted(axes) == list(range(len(reshape))):
+                out_dims = [reshape[a] for a in axes]
+                strides = [1] * len(reshape)
+                for i in range(len(reshape) - 2, -1, -1):
+                    strides[i] = strides[i + 1] * reshape[i + 1]
+                flat: list[int] = []
+                idx = [0] * len(out_dims)
+                for _ in range(total):
+                    src = sum(
+                        idx[j] * strides[axes[j]]
+                        for j in range(len(axes))
+                    )
+                    flat.append(ids[src])
+                    for j in range(len(out_dims) - 1, -1, -1):
+                        idx[j] += 1
+                        if idx[j] < out_dims[j]:
+                            break
+                        idx[j] = 0
+                ids = flat
         # iota groups: reshape [0..total) to dims; groups along last dim.
         group_size = dims[-1] if dims else 1
         n_groups = max(total // max(group_size, 1), 1)
-        it = iter(range(total))
+        it = iter(ids)
         return tuple(
             tuple(next(it) for _ in range(group_size)) for _ in range(n_groups)
         )

@@ -162,12 +162,22 @@ def capture(
     out_bytes = 0
     if include_memcpy:
         try:
+            import math
+
             out_shapes = lowered.out_info
-            out_bytes = sum(
-                int(getattr(s, "size", 0))
-                * getattr(getattr(s, "dtype", None), "itemsize", 0)
-                for s in jax.tree_util.tree_leaves(out_shapes)
-            )
+            out_bytes = 0
+            for s in jax.tree_util.tree_leaves(out_shapes):
+                dt = getattr(s, "dtype", None)
+                if dt is None:
+                    continue
+                # newer jax returns OutInfo leaves carrying shape/dtype
+                # but no .size — derive the element count from the shape
+                # (a scalar's empty shape is 1 element, not 0 bytes)
+                size = getattr(s, "size", None)
+                if size is None:
+                    shape = getattr(s, "shape", None)
+                    size = math.prod(shape) if shape is not None else 0
+                out_bytes += int(size) * getattr(dt, "itemsize", 0)
         except Exception:
             out_bytes = 0
 
