@@ -15,6 +15,7 @@ Usage:
     python ci/check_golden.py --obs-smoke     # obs-export schema smoke
     python ci/check_golden.py --faults-smoke  # degraded-pod schema smoke
     python ci/check_golden.py --serve-smoke   # HTTP daemon determinism
+    python ci/check_golden.py --fastpath-parity  # pricing-backend parity
 """
 
 from __future__ import annotations
@@ -752,6 +753,97 @@ def advise_smoke(update: bool = False) -> dict:
     }
 
 
+def fastpath_smoke() -> dict:
+    """CI tier: pricing-backend parity (the tpusim.fastpath contract).
+
+    1. every golden-matrix config must produce BYTE-identical stats
+       docs through the serial reference walk, the NumPy-vectorized
+       fastpath, and (when built) the native kernel — modulo the
+       opt-in ``fastpath_*`` accounting block;
+    2. the serial doc must still match the committed golden, so the
+       parity chain is anchored to the committed model, not merely
+       self-consistent;
+    3. a streaming leg re-runs the matrix with every module file-backed
+       (``TPUSIM_STREAM_THRESHOLD=0``) and must match the committed
+       goldens too — bounded-RSS pricing is not allowed to change a
+       single stat."""
+    import os
+
+    from tpusim.fastpath import native_price_available, numpy_available
+    from tpusim.sim.driver import simulate_trace
+
+    backends = ["serial"]
+    if numpy_available():
+        backends.append("vectorized")
+    if native_price_available():
+        backends.append("native")
+    if backends == ["serial"]:
+        raise ValueError(
+            "fastpath parity needs at least the vectorized backend "
+            "(numpy not importable)"
+        )
+
+    def run_row(fixture: str, arch: str, overlays: list, backend):
+        report = simulate_trace(
+            FIXTURES / fixture, arch=arch, overlays=list(overlays),
+            tuned=False, pricing_backend=backend,
+        )
+        return {
+            k: v for k, v in json.loads(report.stats.to_json()).items()
+            if k not in VOLATILE and not k.startswith("fastpath_")
+        }
+
+    serial_docs: dict[str, dict] = {}
+    for fixture, arch, overlays in MATRIX:
+        name = f"{fixture}__{arch}"
+        tag = _overlay_tag(overlays)
+        if tag:
+            name += "__" + tag
+        docs = {
+            b: run_row(fixture, arch, overlays, b) for b in backends
+        }
+        blobs = {
+            b: json.dumps(d, sort_keys=True) for b, d in docs.items()
+        }
+        if len(set(blobs.values())) != 1:
+            diverged = [b for b in backends[1:]
+                        if blobs[b] != blobs["serial"]]
+            raise ValueError(
+                f"{name}: pricing backends diverged from the serial "
+                f"walk: {diverged} — the fastpath byte-identity "
+                f"contract is broken"
+            )
+        serial_docs[name] = docs["serial"]
+    errors = compare(serial_docs)
+    if errors:
+        raise ValueError(
+            "fastpath parity: serial anchor diverged from committed "
+            "goldens:\n  " + "\n  ".join(errors)
+        )
+
+    # streaming leg: every module file-backed, default (auto) backend
+    prev = os.environ.get("TPUSIM_STREAM_THRESHOLD")
+    os.environ["TPUSIM_STREAM_THRESHOLD"] = "0"
+    try:
+        streamed = run_matrix()
+    finally:
+        if prev is None:
+            os.environ.pop("TPUSIM_STREAM_THRESHOLD", None)
+        else:
+            os.environ["TPUSIM_STREAM_THRESHOLD"] = prev
+    errors = compare(streamed)
+    if errors:
+        raise ValueError(
+            "fastpath parity: streaming (file-backed) replay diverged "
+            "from committed goldens:\n  " + "\n  ".join(errors)
+        )
+    return {
+        "configs": len(serial_docs),
+        "backends": backends,
+        "streamed_configs": len(streamed),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -787,6 +879,13 @@ def main(argv: list[str] | None = None) -> int:
                          "must run zero engine walks, and the "
                          "dp=4 x tp=2 cell must synthesize the "
                          "14-collective MULTICHIP_r05 step")
+    ap.add_argument("--fastpath-parity", action="store_true",
+                    help="price the golden matrix through every "
+                         "available pricing backend (serial reference "
+                         "walk, NumPy-vectorized, native kernel) plus "
+                         "a file-backed streaming leg: all docs must "
+                         "be byte-identical and match the committed "
+                         "goldens")
     ap.add_argument("--campaign-smoke", action="store_true",
                     help="run the fixed-seed 16-scenario Monte-Carlo "
                          "campaign on the llama_tiny fixture: the "
@@ -795,6 +894,19 @@ def main(argv: list[str] | None = None) -> int:
                          "percentiles, capacity table included) and "
                          "the healthy golden matrix must be untouched")
     args = ap.parse_args(argv)
+
+    if args.fastpath_parity:
+        try:
+            summary = fastpath_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --fastpath-parity: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --fastpath-parity: OK "
+              f"({summary['configs']} configs byte-identical across "
+              f"backends {summary['backends']}; "
+              f"{summary['streamed_configs']} streamed configs match "
+              f"the committed goldens)")
+        return 0
 
     if args.advise_smoke:
         try:

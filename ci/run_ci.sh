@@ -4,7 +4,12 @@
 # gates on the functional-test monitor; Jenkinsfile:26-52 adds the
 # multi-config matrix).  tpusim's tiers:
 #
-#   1. build   — native components (the `make` of accel-sim.out)
+#   1. build   — native components compiled from source (the `make` of
+#                accel-sim.out) + the fastpath/native parity suite run
+#                against the fresh .so; SKIPPED WITH A VISIBLE NOTICE
+#                (never a silent pass) when no C++ compiler is present
+#                — pricing then falls back to the vectorized/serial
+#                Python paths, which the later tiers still verify
 #   2. lint    — repo-wide static analysis (ruff when installed, the
 #                stdlib fallback in ci/lint_repo.py otherwise)
 #   3. unit    — pytest fast tier (the improvement over the reference's
@@ -25,71 +30,90 @@
 #                --workers 4 + an on-disk result cache must match the
 #                committed serial goldens byte-for-byte, and a warm-
 #                cache pass must run zero engine pricing walks
-#   9. serve   — serving-layer determinism: boot the daemon on a free
+#   9. fastpath — pricing-backend parity: the golden matrix priced
+#                through the serial reference walk, the NumPy-vectorized
+#                fastpath, and (when built) the native kernel must be
+#                byte-identical and match the committed goldens; a
+#                streaming leg (every module file-backed) must match too
+#  10. serve   — serving-layer determinism: boot the daemon on a free
 #                loopback port, replay the golden matrix over HTTP;
 #                served stats docs must be byte-identical to the
 #                committed CLI goldens, and a warm second pass must
 #                report cache_hit on every response with zero engine
 #                pricing walks
-#  10. campaign — campaign-layer determinism: a fixed-seed 16-scenario
+#  11. campaign — campaign-layer determinism: a fixed-seed 16-scenario
 #                Monte-Carlo compound-fault campaign on the llama_tiny
 #                fixture must reproduce the committed report
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  11. advise  — sharding-advisor determinism: a fixed-spec strategy
+#  12. advise  — sharding-advisor determinism: a fixed-spec strategy
 #                sweep on the llama_tiny fixture must reproduce the
 #                committed ranked report byte-for-byte (step-time/
 #                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  12. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  13. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-11
+# Usage:  bash ci/run_ci.sh            # tiers 1-12
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/12] build native ==="
-make -C native
+echo "=== [1/13] build native from source (+ native parity suite) ==="
+if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+  make -C native clean all
+  python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
+else
+  echo "**********************************************************************"
+  echo "* NOTICE: no C++ compiler found (\$CXX / g++) — the build-native    *"
+  echo "* tier is SKIPPED.  libtpusim_native.so was NOT rebuilt from source  *"
+  echo "* and the native pricing kernel is unverified on this host; pricing  *"
+  echo "* falls back to the vectorized/serial Python paths (still verified   *"
+  echo "* by the fastpath-parity tier below).                                *"
+  echo "**********************************************************************"
+fi
 
-echo "=== [2/12] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/13] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/12] unit tests (fast tier) ==="
+echo "=== [3/13] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/12] golden-stat regression sims ==="
+echo "=== [4/13] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/12] obs export smoke (schema-checked) ==="
+echo "=== [5/13] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/12] faults smoke (degraded-pod contract) ==="
+echo "=== [6/13] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/12] trace/config/schedule lint smoke ==="
+echo "=== [7/13] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/12] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/13] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/12] serve smoke (HTTP daemon determinism) ==="
+echo "=== [9/13] fastpath parity (pricing-backend byte-identity) ==="
+python ci/check_golden.py --fastpath-parity
+
+echo "=== [10/13] serve smoke (HTTP daemon determinism) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [10/12] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [11/13] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [11/12] advise smoke (sharding-advisor determinism) ==="
+echo "=== [12/13] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [12/12] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [13/13] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [12/12] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [13/13] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -1,0 +1,65 @@
+// Native pricing scan for the tpusim fastpath (tpusim/fastpath/).
+//
+// One fused serial pass over a compiled run of ordinary synchronous
+// ops: advances the core clock and the six counter accumulators in the
+// exact order the Python schedule walk performs its `+=` chain, so the
+// result is byte-identical to the pure-Python path (IEEE-754 binary64,
+// no reassociation -- build with -ffp-contract=off, see the Makefile).
+//
+// Everything stateful (async DMA channels, HBM contention, control
+// flow, collectives) stays in Python: those steps are rare and cheap,
+// while sync runs dominate op counts.  Loaded via ctypes from
+// tpusim/fastpath/native.py with the same fallback contract as
+// hlo_scan.cpp.
+
+#include <cstdint>
+
+extern "C" {
+
+int op_price_abi_version() { return 1; }
+
+// acc layout: [t, flops, mxu_flops, transcendentals, hbm_bytes,
+//              vmem_bytes, vmem_spill_bytes]
+//
+// t_before (nullable) receives the core clock BEFORE each op: the
+// Python side derives per-op aggregate values as (t + dur) - t, the
+// exact float expression the serial walk's _emit performs (it is NOT
+// equal to dur under IEEE rounding, and byte-identity means matching
+// the walk, rounding artifacts included).
+void op_price_scan(int64_t n,
+                   const double* dur,
+                   const double* flops,
+                   const double* mxu,
+                   const double* trans,
+                   const double* hbm,
+                   const double* vmem,
+                   const double* spilled,  // may be null
+                   double* acc,
+                   double* t_before) {     // may be null
+  double t = acc[0];
+  double a_flops = acc[1];
+  double a_mxu = acc[2];
+  double a_trans = acc[3];
+  double a_hbm = acc[4];
+  double a_vmem = acc[5];
+  double a_spill = acc[6];
+  for (int64_t i = 0; i < n; ++i) {
+    if (t_before) t_before[i] = t;
+    t += dur[i];
+    a_flops += flops[i];
+    a_mxu += mxu[i];
+    a_trans += trans[i];
+    a_hbm += hbm[i];
+    a_vmem += vmem[i];
+    if (spilled) a_spill += spilled[i];
+  }
+  acc[0] = t;
+  acc[1] = a_flops;
+  acc[2] = a_mxu;
+  acc[3] = a_trans;
+  acc[4] = a_hbm;
+  acc[5] = a_vmem;
+  acc[6] = a_spill;
+}
+
+}  // extern "C"

@@ -1,0 +1,518 @@
+"""tpusim.fastpath — pricing-backend parity + streaming-RSS contract.
+
+The fastpath's whole license to exist is byte-identity: the serial
+reference walk, the NumPy-vectorized path, and the native kernel must
+produce the same :class:`EngineResult` float for float — not merely
+stats-close.  The corpus test below prices EVERY committed fixture
+trace (single-chip silicon suite + the multi-chip CI fixtures) across
+archs, degraded launch classes, and a faulted topology, comparing the
+FULL serialized result document (per-op aggregates included) across
+backends.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+SILICON = REPO / "reports" / "silicon"
+CI_TRACES = REPO / "tests" / "fixtures" / "traces"
+
+ARCHS = ("v5e", "v5p", "v6e")
+SCALE_CLASSES = ((1.0, 1.0), (0.7, 0.9), (1.0, 0.5))
+
+
+def _numpy_ok() -> bool:
+    from tpusim.fastpath import numpy_available
+
+    return numpy_available()
+
+
+def _native_ok() -> bool:
+    from tpusim.fastpath import native_price_available
+
+    return native_price_available()
+
+
+def _backends() -> list[str]:
+    out = ["serial"]
+    if _numpy_ok():
+        out.append("vectorized")
+    if _native_ok():
+        out.append("native")
+    return out
+
+
+def _corpus() -> list[tuple[str, object]]:
+    """(label, module) for every committed fixture trace module."""
+    from tpusim.trace.format import load_trace
+
+    out = []
+    manifest = json.loads((SILICON / "manifest.json").read_text())
+    for e in manifest["workloads"]:
+        pod = load_trace(SILICON / e["trace"])
+        for mname, mod in sorted(pod.modules.items()):
+            out.append((f"{e['trace']}/{mname}", mod))
+    for tdir in sorted(CI_TRACES.iterdir()):
+        if tdir.is_dir():
+            pod = load_trace(tdir)
+            for mname, mod in sorted(pod.modules.items()):
+                out.append((f"{tdir.name}/{mname}", mod))
+    return out
+
+
+def _doc(result) -> str:
+    from tpusim.perf.cache import result_to_doc
+
+    return json.dumps(result_to_doc(result), sort_keys=False)
+
+
+def _engine(arch, backend, cs=1.0, hs=1.0, topology=None, config=None):
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+
+    return Engine(
+        config or load_config(arch=arch), topology=topology,
+        clock_scale=cs, hbm_scale=hs, pricing_backend=backend,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backend resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_backend_contract(monkeypatch):
+    from tpusim.fastpath import resolve_backend
+
+    assert resolve_backend("serial") == "serial"
+    if _numpy_ok():
+        assert resolve_backend("vectorized") == "vectorized"
+        assert resolve_backend(None) in ("vectorized", "native")
+    monkeypatch.setenv("TPUSIM_PRICING_BACKEND", "serial")
+    assert resolve_backend(None) == "serial"
+    monkeypatch.delenv("TPUSIM_PRICING_BACKEND")
+    with pytest.raises(ValueError):
+        resolve_backend("warp-speed")
+
+
+def test_explicit_native_raises_when_unavailable(monkeypatch):
+    """Pinning an unavailable backend must fail loudly, never silently
+    price through something else."""
+    import tpusim.fastpath.native as fn
+    from tpusim.fastpath import resolve_backend
+
+    monkeypatch.setattr(fn, "_LIB", None)
+    monkeypatch.setattr(fn, "_LIB_TRIED", True)
+    with pytest.raises(ValueError, match="native"):
+        resolve_backend("native")
+    # auto quietly falls back
+    assert resolve_backend(None) in ("vectorized", "serial")
+
+
+# ---------------------------------------------------------------------------
+# The parity corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_corpus_parity_all_archs():
+    """Every fixture module × every arch: serial / vectorized / native
+    full result documents must be byte-identical."""
+    backends = _backends()
+    assert len(backends) >= 2
+    corpus = _corpus()
+    assert len(corpus) >= 10
+    checked = 0
+    for arch in ARCHS:
+        engines = {b: _engine(arch, b) for b in backends}
+        for label, mod in corpus:
+            want = _doc(engines["serial"].run(mod))
+            for b in backends[1:]:
+                got = _doc(engines[b].run(mod))
+                assert got == want, (
+                    f"{label} @ {arch}: backend {b} diverged from the "
+                    f"serial walk"
+                )
+            checked += 1
+    assert checked == len(ARCHS) * len(corpus)
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_corpus_parity_degraded_classes():
+    """Straggler/HBM-throttle launch classes (the faults-layer chip
+    multipliers) through every backend."""
+    backends = _backends()
+    corpus = _corpus()
+    for cs, hs in SCALE_CLASSES[1:]:
+        engines = {b: _engine("v5e", b, cs=cs, hs=hs) for b in backends}
+        for label, mod in corpus:
+            want = _doc(engines["serial"].run(mod))
+            for b in backends[1:]:
+                assert _doc(engines[b].run(mod)) == want, (
+                    f"{label} @ scales ({cs},{hs}): {b} diverged"
+                )
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_corpus_parity_faulted_topology():
+    """A degraded-link fault view changes collective pricing; the
+    compiled columns must flow through the same ICI model."""
+    from tpusim.faults import load_fault_schedule
+    from tpusim.ici.topology import torus_for
+    from tpusim.trace.format import load_trace
+
+    sched = load_fault_schedule({"faults": [
+        {"kind": "link_degraded", "src": 0, "dst": 1,
+         "bandwidth_scale": 0.5},
+    ]})
+    pod = load_trace(CI_TRACES / "llama_tiny_tp2dp2")
+    backends = _backends()
+    for arch in ("v5e", "v5p"):
+        base = torus_for(4, arch)
+        view = sched.bind(base).view_at(0.0)
+        topo = base.with_faults(view)
+        engines = {b: _engine(arch, b, topology=topo) for b in backends}
+        for mname, mod in sorted(pod.modules.items()):
+            want = _doc(engines["serial"].run(mod))
+            for b in backends[1:]:
+                assert _doc(engines[b].run(mod)) == want, (
+                    f"{mname} @ faulted {arch}: {b} diverged"
+                )
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_parity_under_vmem_spill():
+    """A starved vmem budget exercises the spill transform (bytes
+    migrate vmem->HBM, spill counter accumulates) on every backend."""
+    from tpusim.timing.config import load_config
+    from tpusim.trace.format import load_trace
+
+    cfg = load_config(arch="v5e", overlays=[
+        {"arch": {"vmem_bytes": 64 * 1024}},
+    ])
+    corpus = _corpus()
+    backends = _backends()
+    engines = {
+        b: _engine("v5e", b, config=cfg) for b in backends
+    }
+    spilled_somewhere = False
+    for label, mod in corpus:
+        want_res = engines["serial"].run(mod)
+        want = _doc(want_res)
+        if want_res.vmem_spill_bytes > 0:
+            spilled_somewhere = True
+        for b in backends[1:]:
+            assert _doc(engines[b].run(mod)) == want, (
+                f"{label} under spill: {b} diverged"
+            )
+    assert spilled_somewhere, (
+        "corpus never exercised the spill path — the parity claim "
+        "above is vacuous; shrink the vmem overlay"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engagement / disengagement
+# ---------------------------------------------------------------------------
+
+
+def test_fastpath_disengages_under_obs(monkeypatch):
+    """Instrumented runs carry run-scoped observables (samplers, cost
+    wall spans) — the serial walk must stay in charge."""
+    import tpusim.fastpath.price as fp
+    from tpusim.obs import Instrumentation
+    from tpusim.trace.format import load_trace, select_module
+
+    called = []
+    real = fp.price_module
+    monkeypatch.setattr(
+        fp, "price_module",
+        lambda *a, **k: called.append(1) or real(*a, **k),
+    )
+    mod = select_module(load_trace(SILICON / "matmul_chain"), None)
+    obs = Instrumentation(window_cycles=0.0)
+    eng = _engine("v5e", None)
+    eng.obs = obs
+    res = eng.run(mod)
+    assert not called, "fastpath engaged under obs instrumentation"
+    assert res.samples is not None
+    # the same engine without obs engages (auto backend)
+    if _numpy_ok():
+        eng2 = _engine("v5e", None)
+        eng2.run(mod)
+        assert called
+
+
+def test_fastpath_disengages_under_timeline():
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    mod = select_module(load_trace(SILICON / "matmul_chain"), None)
+    eng = Engine(load_config(arch="v5e"), record_timeline=True)
+    res = eng.run(mod)
+    assert res.timeline, "timeline runs must price through the serial walk"
+
+
+def test_cached_engine_composes_with_fastpath():
+    """CachedEngine over the fastpath: hit returns the identical doc,
+    and the cached bytes equal a serial-walk pricing of the same key."""
+    from tpusim.perf.cache import CachedEngine, ResultCache
+    from tpusim.timing.config import load_config
+    from tpusim.trace.format import load_trace, select_module
+
+    mod = select_module(load_trace(SILICON / "reduction"), None)
+    cfg = load_config(arch="v5e")
+    cache = ResultCache()
+    eng = CachedEngine(cfg, result_cache=cache)
+    first = _doc(eng.run(mod))
+    again = _doc(eng.run(mod))
+    assert cache.hits == 1 and first == again
+    serial = _doc(_engine("v5e", "serial").run(mod))
+    assert first == serial
+
+
+# ---------------------------------------------------------------------------
+# Compiled-module cache tier
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_compile_shared_across_launch_classes():
+    """Every degraded launch class of one module must share ONE compile
+    (columns are healthy; transforms are per-class)."""
+    import tpusim.perf.cache as pc
+    from tpusim.trace.format import load_trace, select_module
+
+    mod = select_module(load_trace(SILICON / "mlp_train_step"), None)
+    base_misses = pc._compiled_misses
+    base_hits = pc._compiled_hits
+    for cs, hs in SCALE_CLASSES:
+        _engine("v5e", "vectorized", cs=cs, hs=hs).run(mod)
+    assert pc._compiled_misses - base_misses <= 1
+    assert pc._compiled_hits - base_hits >= 2
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_compile_shared_across_reparse_of_same_text():
+    """A fresh parse of the same text (same content hash) reuses the
+    compiled columns — the content-addressed tier, not object identity."""
+    import tpusim.perf.cache as pc
+    from tpusim.trace.format import load_trace, select_module
+
+    eng = _engine("v5e", "vectorized")
+    m1 = select_module(load_trace(SILICON / "conv2d"), None)
+    eng.run(m1)
+    base_hits = pc._compiled_hits
+    m2 = select_module(load_trace(SILICON / "conv2d"), None)
+    assert m1 is not m2
+    doc1 = _doc(eng.run(m1))
+    doc2 = _doc(eng.run(m2))
+    assert doc1 == doc2
+    assert pc._compiled_hits > base_hits
+
+
+@pytest.mark.skipif(not _numpy_ok(), reason="numpy unavailable")
+def test_custom_cost_model_bypasses_shared_compile_tier():
+    """A caller-supplied cost model is outside every fingerprint: its
+    compiled columns must not cross-serve the default population."""
+    from tpusim.perf.cache import compiled_for
+    from tpusim.timing.config import load_config
+    from tpusim.timing.cost import CostModel
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    mod = select_module(load_trace(SILICON / "reduction"), None)
+    cfg = load_config(arch="v5e")
+    default_eng = Engine(cfg)
+    custom_eng = Engine(
+        cfg, cost_model=CostModel(cfg.arch, custom_call_flops={"k": 1e12}),
+    )
+    cm_default = compiled_for(mod, default_eng)
+    cm_custom = compiled_for(mod, custom_eng)
+    assert cm_default is not cm_custom
+    # and the custom engine's own repeat run reuses ITS compile
+    assert compiled_for(mod, custom_eng) is cm_custom
+
+
+def test_scalar_memo_skips_rewalk_on_reparse(monkeypatch):
+    """The content-hash memo (satellite): a second parse of the same
+    text never re-runs the residency scan."""
+    import tpusim.timing.engine as te
+    from tpusim.trace.format import load_trace, select_module
+
+    calls = []
+    real = te._vmem_resident_bytes
+    monkeypatch.setattr(
+        te, "_vmem_resident_bytes",
+        lambda m: calls.append(1) or real(m),
+    )
+    m1 = select_module(load_trace(SILICON / "transcendental"), None)
+    m2 = select_module(load_trace(SILICON / "transcendental"), None)
+    eng = _engine("v5e", "serial")
+    eng.run(m1)
+    n_after_first = len(calls)
+    eng.run(m2)
+    assert len(calls) == n_after_first, (
+        "re-parse of identical text re-ran the residency walk despite "
+        "the content-hash memo"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Streaming (bounded-RSS) pricing
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_module_stats_parity():
+    """File-backed streaming modules price stats-identically to the
+    serial walk over the same representation (and the index finds the
+    same computations the full parser does)."""
+    from tpusim.trace.format import load_trace, select_module
+    from tpusim.trace.lazy import StreamingModuleTrace
+
+    for name in ("matmul_chain", "decode_step", "lstm_layer"):
+        full = select_module(load_trace(SILICON / name), None)
+        hlo = next((SILICON / name / "modules").glob("*.hlo"))
+        meta = json.loads((SILICON / name / "meta.json").read_text())
+
+        def stamped():
+            m = StreamingModuleTrace(hlo, name_hint=hlo.stem)
+            for k in ("platform", "device_kind"):
+                if k in meta:
+                    m.meta.setdefault(k, meta[k])
+            return m
+
+        assert set(stamped()._spans) == set(full.computations)
+        assert stamped().meta["content_hash"] == \
+            full.meta["content_hash"]
+        want = _engine("v5e", "serial").run(stamped()).stats_dict()
+        for b in _backends()[1:]:
+            got = _engine("v5e", b).run(stamped()).stats_dict()
+            assert json.dumps(got) == json.dumps(want), (
+                f"streaming {name} via {b} diverged"
+            )
+
+
+def test_streaming_releases_parsed_ir():
+    """Fastpath pricing of a streaming module must not retain every
+    parsed computation (compile-then-release)."""
+    if not _numpy_ok():
+        pytest.skip("numpy unavailable")
+    from tpusim.trace.lazy import StreamingModuleTrace
+
+    hlo = next((SILICON / "decode_step" / "modules").glob("*.hlo"))
+    mod = StreamingModuleTrace(hlo, name_hint=hlo.stem, parsed_cap=4)
+    res = _engine("v5e", "vectorized").run(mod)
+    assert res.cycles > 0
+    assert mod.parsed_count <= 4
+    # lean pricing: the per-op name table is the O(trace) memory term
+    assert not res.per_op_cycles
+
+
+_GEN_SNIPPET = r'''
+import json, resource, sys
+from tpusim.sim.driver import simulate_trace
+from tpusim.trace.lazy import StreamingModuleTrace
+
+if sys.argv[1] == "--baseline":
+    # same imports, zero trace work: the interpreter+numpy floor
+    print(json.dumps({
+        "peak_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+    }))
+    raise SystemExit(0)
+report = simulate_trace(sys.argv[1], arch="v5e", tuned=False)
+peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(json.dumps({
+    "peak_kb": peak_kb,
+    "sim_cycle": report.stats.get("sim_cycle"),
+    "ops": report.totals.op_count,
+}))
+'''
+
+
+@pytest.mark.slow
+def test_streaming_bounded_rss_on_200mb_trace(tmp_path):
+    """Acceptance: a synthetic >=200 MB trace prices to completion in
+    streaming mode with peak RSS bounded well below the trace size.
+
+    Runs in a subprocess so ru_maxrss measures THIS pricing run, not
+    the test session's high-water mark."""
+    n_comps, n_ops = 300, 1000
+    pad = "x" * 580
+    tdir = tmp_path / "giant"
+    (tdir / "modules").mkdir(parents=True)
+    (tdir / "meta.json").write_text(json.dumps({
+        "format_version": 1, "platform": "tpu",
+        "device_kind": "TPU v5 lite",
+    }))
+    hlo = tdir / "modules" / "giant.hlo"
+    with open(hlo, "w") as f:
+        f.write("HloModule giant_stream, is_scheduled=true\n\n")
+        for c in range(n_comps):
+            f.write(f"%body_{c} (p0: f32[512,512]) -> f32[512,512] {{\n")
+            f.write("  %p0 = f32[512,512]{1,0:T(8,128)} parameter(0)\n")
+            prev = "%p0"
+            for i in range(n_ops):
+                f.write(
+                    f"  %add_{i} = f32[512,512]{{1,0:T(8,128)}} "
+                    f"add({prev}, %p0), metadata={{op_name="
+                    f"\"layer{c}/add{i}/{pad}\" source_file=\"g.py\" "
+                    f"source_line={i}}}\n"
+                )
+                prev = f"%add_{i}"
+            f.write(f"  ROOT %root = f32[512,512]{{1,0:T(8,128)}} "
+                    f"copy({prev})\n}}\n\n")
+        f.write("ENTRY %main (p0: f32[512,512]) -> f32[512,512] {\n")
+        f.write("  %p0 = f32[512,512]{1,0:T(8,128)} parameter(0)\n")
+        prev = "%p0"
+        for c in range(n_comps):
+            f.write(f"  %call_{c} = f32[512,512]{{1,0:T(8,128)}} "
+                    f"call({prev}), to_apply=%body_{c}\n")
+            prev = f"%call_{c}"
+        f.write(f"  ROOT %out = f32[512,512]{{1,0:T(8,128)}} "
+                f"copy({prev})\n}}\n")
+    size = hlo.stat().st_size
+    assert size >= 200 * 1024 * 1024, f"generator produced {size} bytes"
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    base_proc = subprocess.run(
+        [sys.executable, "-c", _GEN_SNIPPET, "--baseline"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert base_proc.returncode == 0, base_proc.stderr[-2000:]
+    baseline = json.loads(
+        base_proc.stdout.strip().splitlines()[-1]
+    )["peak_kb"] * 1024
+
+    proc = subprocess.run(
+        [sys.executable, "-c", _GEN_SNIPPET, str(tdir)],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sim_cycle"] > 0
+    assert out["ops"] >= n_comps * n_ops
+    peak = out["peak_kb"] * 1024
+    # The trace-dependent memory (peak minus the interpreter+numpy
+    # import floor, which is ~80 MB regardless of trace size) must be
+    # well below the trace: compiled columns + span index + a handful
+    # of parsed computations, never the text.  The absolute cap would
+    # trip on any regression that materializes the full text (that
+    # alone would add ~size bytes).
+    assert peak - baseline < 0.35 * size, (
+        f"streaming pricing added {(peak - baseline) / 1e6:.0f} MB over "
+        f"the {baseline / 1e6:.0f} MB import floor — not well below "
+        f"the {size / 1e6:.0f} MB trace"
+    )
+    assert peak < 0.75 * size, (
+        f"absolute peak RSS {peak / 1e6:.0f} MB too close to the "
+        f"{size / 1e6:.0f} MB trace size (full-text materialization?)"
+    )

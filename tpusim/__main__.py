@@ -53,6 +53,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         faults=faults, lenient=args.lenient_parse,
         validate=args.validate,
         result_cache=args.result_cache, workers=args.workers,
+        pricing_backend=args.pricing_backend,
     )
     if args.power and report.power is not None:
         print(report.power.report_text())
@@ -988,6 +989,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="skip malformed HLO lines with a counted "
                          "warning instead of raising mid-file (salvage "
                          "mode for damaged captures)")
+    ps.add_argument("--pricing-backend", default=None,
+                    choices=["auto", "serial", "vectorized", "native"],
+                    help="pin the tpusim.fastpath pricing backend (all "
+                         "byte-identical; default auto = fastest "
+                         "available; also via $TPUSIM_PRICING_BACKEND) "
+                         "and stamp fastpath_* stats on the report")
     ps.add_argument("--workers", type=int, default=None, metavar="N",
                     help="fan module pricing over N processes "
                          "(default: $TPUSIM_WORKERS, else serial); "

@@ -46,9 +46,12 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     # derive ici_occupancy/ici_gbps tracks; the advisor's report rows
     # and the CLI's ranked table carry the same ici_bytes meaning
     # verbatim (one name, one meaning, more surfaces)
+    # tpusim/fastpath/ carries the engine's ici_bytes column through
+    # its compiled columns verbatim (one name, one meaning)
     "ici_": (
         "tpusim/ici/", "tpusim/obs/", "tpusim/timing/engine.py",
         "tpusim/sim/driver.py", "tpusim/advise/", "tpusim/__main__.py",
+        "tpusim/fastpath/",
     ),
     # the performance layer (PR 4): result-cache effectiveness
     # (hits/misses/evictions + disk tier) — stamped by the driver only
@@ -79,6 +82,16 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     "campaign_": (
         "tpusim/campaign/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
+    ),
+    # the pricing fastpath (PR 8): compiled-pricing accounting (resolved
+    # backend, compiled-module cache hits/misses) — stamped by the
+    # driver ONLY when a --pricing-backend was explicitly requested
+    # (the cache_*/pool_* discipline: default auto-fastpath runs stay
+    # key-identical, which is what keeps the golden matrix byte-stable
+    # with the fastpath on)
+    "fastpath_": (
+        "tpusim/fastpath/", "tpusim/sim/driver.py", "tpusim/__main__.py",
+        "bench.py", "ci/check_golden.py",
     ),
     # the sharding advisor (PR 7): strategy-sweep executor accounting
     # (cells priced/skipped/feasible) — stamped only when an advise
@@ -126,6 +139,7 @@ AUDIT_GLOBS = (
     "tpusim/faults/*.py",
     "tpusim/ici/*.py",
     "tpusim/perf/*.py",
+    "tpusim/fastpath/*.py",
     "tpusim/serve/*.py",
     "tpusim/campaign/*.py",
     "tpusim/advise/*.py",

@@ -1,0 +1,50 @@
+"""Batch-vectorized pricing fastpath (architecture slot L17).
+
+The hot path under every other layer — serve throughput, sweep breadth,
+campaign scale, and calibration iteration all sit on the engine's
+schedule walk (ROADMAP item 2 calls it "the multiplier under every
+other item").  This package splits that walk into two phases:
+
+* **compile** (:mod:`tpusim.fastpath.compile`) — one pass over a module
+  turns each computation into flat float64 columns (cycles / bytes /
+  flops per op) plus a step program (control flow, async joins,
+  collectives, and contiguous *runs* of ordinary synchronous ops).
+  Compiled once per (module content hash, composed config), cached in
+  :mod:`tpusim.perf.cache` beside the PR 4 result cache.
+* **price** (:mod:`tpusim.fastpath.price`) — replays the step program
+  for one launch class (clock/HBM multipliers, spill fraction).  Runs
+  of sync ops accumulate through NumPy serial scans (``cumsum``) or the
+  ``native/op_price.cpp`` kernel; everything stateful (async DMA
+  channels, ICI rendezvous, HBM contention, control flow) steps through
+  the same scalar logic as the reference walk.
+
+Contract: every backend — ``serial`` (the reference per-op walk in
+:class:`tpusim.timing.engine.Engine`), ``vectorized``, and ``native`` —
+produces **byte-identical** :class:`EngineResult` counters, pinned by
+the parity corpus in ``tests/test_fastpath.py`` and the
+``--fastpath-parity`` CI smoke.  The fastpath disengages (falls back to
+the serial walk) under obs instrumentation, timeline recording, and
+op-granularity checkpoint/resume — see ``resolve_backend``.
+"""
+
+from tpusim.fastpath.compile import CompiledComputation, CompiledModule, compile_module
+from tpusim.fastpath.price import (
+    BACKENDS,
+    fastpath_eligible,
+    numpy_available,
+    price_module,
+    resolve_backend,
+)
+from tpusim.fastpath.native import native_price_available
+
+__all__ = [
+    "BACKENDS",
+    "CompiledComputation",
+    "CompiledModule",
+    "compile_module",
+    "fastpath_eligible",
+    "native_price_available",
+    "numpy_available",
+    "price_module",
+    "resolve_backend",
+]

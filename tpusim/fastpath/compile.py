@@ -1,0 +1,333 @@
+"""Phase 1: compile a module's computations into flat pricing columns.
+
+One cost-model pass per computation produces parallel float64 columns
+(one row per scheduled op) plus a *step program* that preserves the
+serial walk's structure:
+
+* ``("run", lo, hi, ...)``    — a contiguous block of ordinary
+  synchronous ops with **no async DMA statically in flight**: safe to
+  accumulate in one vectorized serial scan (HBM contention cannot
+  engage, so every op's duration is its precompiled column value after
+  the launch-class transforms).
+* ``("crun", lo, hi)``        — sync ops inside a DMA-in-flight region;
+  stepped one by one with the full contention logic.
+* scalar steps for control flow (``while``/``cond``/``call``), async
+  joins, collectives, and async DMA starts.
+
+Whether DMA is in flight is static: ``pending`` starts empty at every
+computation entry, async starts open it, their ``-done`` joins close it,
+and after the last join the core clock provably sits at-or-past the DMA
+channel horizon (``finish = start + latency + dur >= start + dur``), so
+the contention predicate ``dma_busy_until > t`` is statically false in
+``run`` blocks.  A start that is never joined keeps the rest of the
+computation in ``crun`` conservatively.
+
+Columns hold the *healthy* per-op costs; degraded-chip multipliers and
+vmem spill are applied per launch class at price time (see
+``price._view``) with the exact float-op sequence of the serial walk.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+from tpusim.ir import Computation, ModuleTrace, Unit
+from tpusim.timing.config import SimConfig
+from tpusim.timing.cost import CostModel, while_trip_count
+
+__all__ = ["CompiledComputation", "CompiledModule", "compile_module"]
+
+#: done-op bases whose wait is exposed-collective time (the engine's
+#: join classification, timing/engine.py)
+_COLL_DONE_BASES = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast", "ragged-all-to-all",
+})
+
+
+def _np():
+    import numpy
+
+    return numpy
+
+
+@dataclass
+class CompiledComputation:
+    """Flat columns + step program for one computation."""
+
+    name: str
+    n_ops: int
+    #: per-op identity (None when compiled lean for streaming pricing)
+    names: list[str] | None
+    bases: list[str]
+    #: per-op unit value string (None for rows that never emit)
+    units: list = field(default_factory=list)
+    #: float64 columns, one row per op (zeros for non-sync rows)
+    cycles: object = None
+    compute: object = None
+    hbm: object = None
+    vmem: object = None
+    hrs: object = None          # hbm_rate_scale
+    vrs: object = None          # vmem_rate_scale
+    flops: object = None
+    mxu: object = None
+    trans: object = None
+    ici_bytes: object = None
+    #: the step program (tuples; see module docstring)
+    steps: list = field(default_factory=list)
+    #: True when any column row has vmem > 0 / any degradable cycles —
+    #: lets price skip building transform views that would be identity
+    any_vmem: bool = False
+    #: cached .tolist() views of the healthy columns (built lazily)
+    _lists: dict = field(default_factory=dict, repr=False)
+
+    def col_list(self, attr: str) -> list:
+        cached = self._lists.get(attr)
+        if cached is None:
+            cached = self._lists[attr] = getattr(self, attr).tolist()
+        return cached
+
+
+class CompiledModule:
+    """Lazily-compiled computations of one module (compiled as the
+    pricing walk first reaches them — a streaming pod never compiles
+    computations its schedule never runs).
+
+    Only a WEAK reference to the source :class:`ModuleTrace` is held:
+    the content-addressed cache tier in :mod:`tpusim.perf.cache` keeps
+    instances alive process-wide, and a strong ref would pin every
+    priced module's parsed IR (and a lazy module's full text) for the
+    process lifetime.  Every pricing call re-binds the live module via
+    :func:`tpusim.perf.cache.compiled_for` before any lazy compile can
+    need it."""
+
+    def __init__(self, module: ModuleTrace, cost: CostModel,
+                 config: SimConfig, lean: bool = False,
+                 release_ir: bool = False):
+        import weakref
+
+        self._module_ref = weakref.ref(module)
+        self.cost = cost
+        self.config = config
+        self.lean = lean               # skip per-op identity (streaming)
+        self.release_ir = release_ir   # drop parsed IR after compile
+        self.comps: dict[str, CompiledComputation] = {}
+
+    def bind(self, module: ModuleTrace, cost: CostModel) -> None:
+        """(Re)attach the live module for lazy compiles of computations
+        the walk has not reached yet (same content hash by key
+        construction, so the columns transfer)."""
+        import weakref
+
+        self._module_ref = weakref.ref(module)
+        self.cost = cost
+
+    @property
+    def module(self) -> ModuleTrace:
+        m = self._module_ref()
+        if m is None:
+            raise RuntimeError(
+                "CompiledModule's source ModuleTrace was released; "
+                "re-enter through tpusim.perf.cache.compiled_for"
+            )
+        return m
+
+    def comp(self, name: str) -> CompiledComputation:
+        cc = self.comps.get(name)
+        if cc is None:
+            module = self.module
+            comp = module.computation(name)
+            cc = compile_computation(
+                module, comp, self.cost, self.config, lean=self.lean
+            )
+            self.comps[name] = cc
+            if self.release_ir:
+                release = getattr(module, "release_computation", None)
+                if release is not None:
+                    release(name)
+        return cc
+
+
+def compile_computation(
+    module: ModuleTrace,
+    comp: Computation,
+    cost_model: CostModel,
+    config: SimConfig,
+    lean: bool = False,
+) -> CompiledComputation:
+    """One cost-model pass over ``comp`` -> columns + step program."""
+    np = _np()
+    ops = comp.ops
+    n = len(ops)
+    # lean (streaming) compiles drop the per-op identity column — the
+    # one O(distinct names) memory term — but keep bases: opcode_cycles
+    # accumulates in every mode.  Bases are interned: every parse mints
+    # its own "add"/"fusion" string objects, and a streaming compile
+    # retaining one per op would hold O(ops) duplicates of a dozen
+    # distinct opcodes.
+    intern = sys.intern
+    names: list[str] | None = None if lean else [op.name for op in ops]
+    bases: list[str] = [intern(op.base) for op in ops]
+
+    cycles = np.zeros(n)
+    compute = np.zeros(n)
+    hbm = np.zeros(n)
+    vmem = np.zeros(n)
+    hrs = np.ones(n)
+    vrs = np.ones(n)
+    flops = np.zeros(n)
+    mxu = np.zeros(n)
+    trans = np.zeros(n)
+    icib = np.zeros(n)
+    unit_val: list[str | None] = [None] * n
+
+    steps: list = []
+    dma_open: set[str] = set()   # async DMA starts not yet joined
+    run_lo = -1                  # open run/crun block start
+    run_kind = ""
+
+    def close_run(hi: int) -> None:
+        nonlocal run_lo, run_kind
+        if run_lo < 0:
+            return
+        if run_kind == "run":
+            steps.append(_finish_run(run_lo, hi))
+        else:
+            steps.append(("crun", run_lo, hi))
+        run_lo = -1
+
+    def _finish_run(lo: int, hi: int):
+        # emit mask (dur > 0 is static: transforms only grow positive
+        # durations and leave exact zeros exactly zero), plus the
+        # grouped-accumulator index tables the vector executor chains.
+        # All index tables are kept as compact intp arrays, NOT lists
+        # of Python ints: a streaming compile interleaves these
+        # long-lived tables with per-computation parse garbage, and
+        # boxed ints would pin allocator arenas (the bounded-RSS
+        # contract).  The per-op executor converts lazily.
+        emit = np.nonzero(cycles[lo:hi] > 0.0)[0] + lo
+        hbm_idx = np.nonzero(hbm[lo:hi] > 0.0)[0] + lo
+        flops_idx = np.nonzero(flops[lo:hi] > 0.0)[0] + lo
+        mxu_idx = np.nonzero(mxu[lo:hi] > 0.0)[0] + lo
+        ug: dict[str, list[int]] = {}
+        og: dict[str, list[int]] = {}
+        for i in emit.tolist():
+            ug.setdefault(unit_val[i], []).append(i)
+            og.setdefault(bases[i], []).append(i)
+        ugroups = [(u, np.asarray(idx, dtype=np.intp))
+                   for u, idx in ug.items()]
+        ogroups = [(b, np.asarray(idx, dtype=np.intp))
+                   for b, idx in og.items()]
+        return (
+            "run", lo, hi, emit, hbm_idx, flops_idx, mxu_idx,
+            ugroups, ogroups,
+        )
+
+    def open_run(i: int) -> None:
+        nonlocal run_lo, run_kind
+        kind = "run" if not dma_open else "crun"
+        if run_lo >= 0 and run_kind == kind:
+            return
+        close_run(i)
+        run_lo = i
+        run_kind = kind
+
+    for i, op in enumerate(ops):
+        base = op.base
+
+        if base == "while" and len(op.called) >= 1:
+            close_run(i)
+            body = op.attrs.get("body", "").lstrip("%") or op.called[0]
+            trips = while_trip_count(op, 0)
+            unknown = False
+            if trips <= 0:
+                from tpusim.trace.loop_analysis import infer_trip_count
+
+                trips = infer_trip_count(module, comp, op, -1)
+                if trips < 0:
+                    trips = config.default_loop_trip_count
+                    unknown = True
+            steps.append(("while", i, op.name, base, body, trips, unknown))
+            continue
+        if base == "conditional" and op.called:
+            close_run(i)
+            branches = tuple(
+                b for b in op.called if b in module.computations
+            )
+            steps.append(("cond", i, op.name, base, branches))
+            continue
+        if base == "call" and op.called:
+            close_run(i)
+            steps.append(("call", i, op.name, base, op.called[0]))
+            continue
+        if op.is_async_done:
+            close_run(i)
+            src = op.operands[0] if op.operands else None
+            steps.append(("done", i, src, base in _COLL_DONE_BASES))
+            if src is not None:
+                dma_open.discard(src)
+            continue
+
+        cost = cost_model.op_cost(op, comp, module)
+        cycles[i] = cost.cycles
+        compute[i] = cost.compute_cycles
+        hbm[i] = cost.hbm_bytes
+        vmem[i] = cost.vmem_bytes
+        hrs[i] = cost.hbm_rate_scale
+        vrs[i] = cost.vmem_rate_scale
+        flops[i] = cost.flops
+        mxu[i] = cost.mxu_flops
+        trans[i] = cost.transcendentals
+        unit_val[i] = cost.unit.value
+
+        if op.is_collective:
+            close_run(i)
+            icib[i] = cost.ici_bytes
+            steps.append((
+                "coll", i, op.name, base, op.collective,
+                op.is_async_start,
+            ))
+            continue
+        if op.is_async_start:
+            close_run(i)
+            steps.append(("dma", i, op.name, base))
+            dma_open.add(op.name)
+            continue
+
+        open_run(i)
+
+    close_run(n)
+
+    cc = CompiledComputation(
+        name=comp.name, n_ops=n, names=names, bases=bases,
+        units=unit_val,
+        cycles=cycles, compute=compute, hbm=hbm, vmem=vmem,
+        hrs=hrs, vrs=vrs, flops=flops, mxu=mxu, trans=trans,
+        ici_bytes=icib, steps=steps,
+        any_vmem=bool((vmem > 0.0).any()),
+    )
+    return cc
+
+
+def compile_module(
+    module: ModuleTrace,
+    cost_model: CostModel,
+    config: SimConfig,
+    lean: bool = False,
+    release_ir: bool = False,
+) -> CompiledModule:
+    """A lazily-populated :class:`CompiledModule`.  Callers wanting
+    cross-engine reuse go through :func:`tpusim.perf.cache.
+    compiled_for` instead, which keys instances under the module's
+    content hash beside the result cache."""
+    return CompiledModule(
+        module=module, cost=cost_model, config=config, lean=lean,
+        release_ir=release_ir,
+    )
+
+
+# re-export for price.py (one source of truth for the unit-string table)
+UNIT_SCALAR = Unit.SCALAR.value
+UNIT_ICI = Unit.ICI.value
+UNIT_DMA = Unit.DMA.value

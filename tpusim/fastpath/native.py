@@ -1,0 +1,93 @@
+"""ctypes bridge to the native pricing scan (``native/op_price.cpp``).
+
+Same shape as the HLO-scanner bridge (:mod:`tpusim.trace.native`): the
+shared library is optional, ``TPUSIM_NO_NATIVE`` is honored through the
+shared loader, the ABI is version-checked, and the Python/NumPy path is
+always available as a byte-identical fallback.
+
+The kernel is deliberately tiny: one fused **serial** scan over a run of
+pre-transformed sync-op columns, accumulating the seven walk
+accumulators (core clock, flops, mxu_flops, transcendentals, hbm_bytes,
+vmem_bytes, vmem_spill_bytes) in exactly the serial walk's float order.
+C ``double`` arithmetic is IEEE-754 binary64 like CPython floats and
+NumPy float64 (the Makefile pins ``-ffp-contract=off`` so no FMA
+contraction reassociates an add), which is what makes the native path
+byte-identical rather than merely close.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+__all__ = ["native_price_available", "price_scan"]
+
+_LIB: ctypes.CDLL | None = None
+_LIB_TRIED = False
+
+_ACC_SLOTS = 7  # [t, flops, mxu, trans, hbm, vmem, spill]
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    from tpusim.trace.native import load_shared_lib
+
+    lib = load_shared_lib()
+    if lib is None:
+        return None
+    try:
+        lib.op_price_abi_version.restype = ctypes.c_int
+        if lib.op_price_abi_version() != 1:
+            return None
+        lib.op_price_scan.restype = None
+        lib.op_price_scan.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),  # dur
+            ctypes.POINTER(ctypes.c_double),  # flops
+            ctypes.POINTER(ctypes.c_double),  # mxu
+            ctypes.POINTER(ctypes.c_double),  # trans
+            ctypes.POINTER(ctypes.c_double),  # hbm
+            ctypes.POINTER(ctypes.c_double),  # vmem
+            ctypes.POINTER(ctypes.c_double),  # spilled (may be NULL)
+            ctypes.POINTER(ctypes.c_double),  # acc[7], in/out
+            ctypes.POINTER(ctypes.c_double),  # t_before (may be NULL)
+        ]
+        _LIB = lib
+    except (OSError, AttributeError):
+        return None
+    return _LIB
+
+
+def native_price_available() -> bool:
+    """True when the op_price kernel is loadable (library built, ABI
+    matches, ``TPUSIM_NO_NATIVE`` unset)."""
+    return _load() is not None
+
+
+_DP = ctypes.POINTER(ctypes.c_double)
+
+
+def _ptr(arr) -> "ctypes.POINTER":
+    return arr.ctypes.data_as(_DP)
+
+
+def price_scan(dur, flops, mxu, trans, hbm, vmem, spilled, acc,
+               t_before=None) -> None:
+    """Run the fused serial scan over one sync run.  All arrays are
+    contiguous float64; ``acc`` is the 7-slot accumulator vector,
+    updated in place.  ``spilled`` may be None (no vmem spill active);
+    ``t_before`` (same length as ``dur``) receives the pre-op core
+    clock when per-op aggregates are being collected."""
+    lib = _load()
+    assert lib is not None
+    assert acc.shape[0] == _ACC_SLOTS
+    lib.op_price_scan(
+        dur.shape[0],
+        _ptr(dur), _ptr(flops), _ptr(mxu), _ptr(trans),
+        _ptr(hbm), _ptr(vmem),
+        _ptr(spilled) if spilled is not None else None,
+        _ptr(acc),
+        _ptr(t_before) if t_before is not None else None,
+    )

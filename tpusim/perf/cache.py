@@ -58,6 +58,8 @@ __all__ = [
     "DEFAULT_CACHE_DIR",
     "ResultCache",
     "as_result_cache",
+    "compiled_cache_stats",
+    "compiled_for",
     "config_fingerprint",
     "module_fingerprint",
     "module_uses_ici",
@@ -89,6 +91,14 @@ _PARSER_FILES: tuple[str, ...] = (
     "tpusim/trace/loop_analysis.py",
     "tpusim/trace/format.py",
     "native/hlo_scan.cpp",
+    # the pricing fastpath is byte-identical to the engine BY CONTRACT,
+    # but a contract is not a key: an edit that (wrongly or rightly)
+    # shifts compiled pricing must orphan old disk records rather than
+    # serve pre-edit bytes forever
+    "tpusim/fastpath/compile.py",
+    "tpusim/fastpath/price.py",
+    "tpusim/fastpath/native.py",
+    "native/op_price.cpp",
 )
 
 _parser_version_cache: str | None = None
@@ -198,9 +208,20 @@ def module_uses_ici(module) -> bool:
 def config_fingerprint(config: SimConfig) -> str:
     """Digest of the fully-composed config (arch preset + tuned overlay
     + explicit overlays all flattened — frozen dataclasses serialize
-    deterministically)."""
+    deterministically).  Memoized on the instance: SimConfig is frozen,
+    and ``dataclasses.asdict``'s deep copy is expensive enough to
+    dominate a warm fastpath replay if recomputed per run."""
+    cached = config.__dict__.get("_fingerprint_memo") \
+        if hasattr(config, "__dict__") else None
+    if cached is not None:
+        return cached
     doc = dataclasses.asdict(config)
-    return _sha(json.dumps(doc, sort_keys=True, default=str))
+    fp = _sha(json.dumps(doc, sort_keys=True, default=str))
+    try:
+        object.__setattr__(config, "_fingerprint_memo", fp)
+    except (AttributeError, TypeError):
+        pass
+    return fp
 
 
 def topology_signature(topo) -> str | None:
@@ -332,7 +353,7 @@ class ResultCache:
         # HLO text captured on cpu vs tpu prices differently
         platform = str(module.meta.get("platform", "")) if module.meta \
             else ""
-        return "|".join((
+        key = "|".join((
             mfp,
             f"p={platform}",
             config_fingerprint(config),
@@ -341,6 +362,11 @@ class ResultCache:
             f"{scales[0]!r},{scales[1]!r}",
             topo_part,
         ))
+        if getattr(module, "stream_lean", False):
+            # streaming-lean results carry no per-op aggregates; they
+            # must never cross-serve a full-fidelity consumer
+            key += "|lean"
+        return key
 
     # -- lookup / insert -----------------------------------------------------
 
@@ -489,6 +515,126 @@ def as_result_cache(spec, obs=None) -> ResultCache | None:
     if spec is True:
         return ResultCache(disk_dir=DEFAULT_CACHE_DIR, obs=obs)
     return ResultCache(disk_dir=spec, obs=obs)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-module cache tier (tpusim.fastpath phase 1)
+# ---------------------------------------------------------------------------
+
+#: process-wide LRU of fastpath CompiledModule instances, keyed beside
+#: the result cache: (module content fingerprint, capture platform,
+#: composed-config fingerprint, model+parser version, lean flag).  The
+#: platform joins the key for the same reason it joins result-cache
+#: keys: the cost model's capture-backend dtype normalization makes
+#: identical HLO text price differently per capture platform.  Scales
+#: and topology are deliberately ABSENT — compiled columns hold healthy
+#: per-op costs, and launch-class transforms apply at price time, which
+#: is exactly why a fault sweep's every degraded class shares one
+#: compile.
+_COMPILED: OrderedDict = OrderedDict()
+COMPILED_CACHE_MAX = 256
+_compiled_hits = 0
+_compiled_misses = 0
+#: the serving daemon prices from many request threads against this one
+#: process-wide tier; the lock covers the LRU mutations (move_to_end
+#: racing an eviction corrupts an OrderedDict), not compilation itself —
+#: two threads compiling the same cold key just duplicate pure work
+_compiled_lock = threading.Lock()
+
+
+def _compiled_key(module, config: SimConfig, lean: bool) -> tuple | None:
+    mfp = module_fingerprint(module)
+    if mfp is None:
+        return None
+    platform = str(module.meta.get("platform", "")) if module.meta else ""
+    return (
+        mfp, platform, config_fingerprint(config),
+        f"{model_version()}+{parser_version()}", lean,
+    )
+
+
+def compiled_for(module, engine):
+    """The fastpath's one compile per (module content, config): return
+    a cached :class:`tpusim.fastpath.compile.CompiledModule` or mint
+    one.  Engines with a caller-supplied cost model bypass the shared
+    tier (their model is outside every fingerprint) and pin compiled
+    columns to the module object + model token instead."""
+    global _compiled_hits, _compiled_misses
+    from tpusim.fastpath.compile import compile_module
+
+    lean = bool(getattr(module, "stream_lean", False))
+    if not getattr(engine, "_default_cost_model", True):
+        token = getattr(engine.cost, "_cache_token", None)
+        attr = getattr(module, "_fastpath_custom_cms", None)
+        if attr is None:
+            attr = {}
+            try:
+                module._fastpath_custom_cms = attr
+            except (AttributeError, TypeError):
+                return compile_module(
+                    module, engine.cost, engine.config, lean=lean,
+                    release_ir=lean,
+                )
+        key = (token, config_fingerprint(engine.config), lean)
+        cm = attr.get(key)
+        if cm is None:
+            cm = attr[key] = compile_module(
+                module, engine.cost, engine.config, lean=lean,
+                release_ir=lean,
+            )
+        return cm
+
+    key = _compiled_key(module, engine.config, lean)
+    if key is None:
+        # no stable fingerprint: fall back to a module-object attr so
+        # repeated runs of the same object still compile once
+        attr = getattr(module, "_fastpath_cm", None)
+        ckey = (config_fingerprint(engine.config), lean)
+        if isinstance(attr, dict) and ckey in attr:
+            return attr[ckey]
+        cm = compile_module(
+            module, engine.cost, engine.config, lean=lean,
+            release_ir=lean,
+        )
+        try:
+            if not isinstance(attr, dict):
+                attr = {}
+                module._fastpath_cm = attr
+            attr[ckey] = cm
+        except (AttributeError, TypeError):
+            pass
+        return cm
+
+    with _compiled_lock:
+        cm = _COMPILED.get(key)
+        if cm is not None:
+            _COMPILED.move_to_end(key)
+            _compiled_hits += 1
+    if cm is not None:
+        # the tier holds only a weak module ref; rebind the live object
+        # (same content hash by key construction — the columns
+        # transfer) so not-yet-reached computations can still compile
+        cm.bind(module, engine.cost)
+        return cm
+    cm = compile_module(
+        module, engine.cost, engine.config, lean=lean, release_ir=lean,
+    )
+    with _compiled_lock:
+        _compiled_misses += 1
+        _COMPILED[key] = cm
+        while len(_COMPILED) > COMPILED_CACHE_MAX:
+            _COMPILED.popitem(last=False)
+    return cm
+
+
+def compiled_cache_stats() -> dict[str, float]:
+    """Counters for the ``fastpath_`` stats block (stamped by the
+    driver only when a pricing backend was explicitly requested)."""
+    return {
+        "compile_hits": _compiled_hits,
+        "compile_misses": _compiled_misses,
+        "compiled_modules": len(_COMPILED),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -41,19 +41,20 @@ def _price_segment_worker(item):
     Pure: same engine math as the serial path, so the returned counters
     are bit-identical to an in-process run."""
     name, scales = item
-    cfg, topo, modules, cache = pool_context()
+    cfg, topo, modules, cache, backend = pool_context()
     if cache is not None:
         from tpusim.perf.cache import CachedEngine
 
         eng = CachedEngine(
             cfg, topology=topo,
             clock_scale=scales[0], hbm_scale=scales[1],
-            result_cache=cache,
+            result_cache=cache, pricing_backend=backend,
         )
     else:
         eng = Engine(
             cfg, topology=topo,
             clock_scale=scales[0], hbm_scale=scales[1],
+            pricing_backend=backend,
         )
     return eng.run(modules[name])
 
@@ -145,6 +146,7 @@ class SimDriver:
         faults=None,
         result_cache=None,
         workers: int | None = None,
+        pricing_backend: str | None = None,
     ):
         self.config = config
         self.arch = config.arch
@@ -166,6 +168,11 @@ class SimDriver:
         else:
             self.result_cache = None
         self.workers = workers
+        # tpusim.fastpath: pricing-backend request (None = auto-resolve;
+        # an EXPLICIT request also stamps the fastpath_* stats block on
+        # the report — the faults_* discipline, so default runs stay
+        # key-identical)
+        self.pricing_backend = pricing_backend
 
     # ------------------------------------------------------------------
 
@@ -210,11 +217,15 @@ class SimDriver:
             def _new_engine(**kw) -> Engine:
                 return CachedEngine(
                     cfg, topology=topo, obs=obs,
-                    result_cache=self.result_cache, **kw,
+                    result_cache=self.result_cache,
+                    pricing_backend=self.pricing_backend, **kw,
                 )
         else:
             def _new_engine(**kw) -> Engine:
-                return Engine(cfg, topology=topo, obs=obs, **kw)
+                return Engine(
+                    cfg, topology=topo, obs=obs,
+                    pricing_backend=self.pricing_backend, **kw,
+                )
 
         engine = _new_engine()
 
@@ -364,7 +375,8 @@ class SimDriver:
             if len(remaining) > 1:
                 priced = map_ordered(
                     _price_segment_worker, remaining, workers=workers,
-                    context=(cfg, topo, pod.modules, self.result_cache),
+                    context=(cfg, topo, pod.modules, self.result_cache,
+                             self.pricing_backend),
                 )
                 pool_segments = len(remaining)
                 for mkey, res in zip(remaining, priced):
@@ -619,6 +631,22 @@ class SimDriver:
                 {"workers": workers, "parallel_segments": pool_segments},
                 prefix="pool_",
             )
+        if self.pricing_backend is not None:
+            # fastpath accounting rides the report ONLY when a backend
+            # was explicitly requested (the faults_*/cache_* discipline:
+            # default auto-fastpath runs stay key-identical, goldens
+            # unchanged).  The stamped name is what actually priced:
+            # under obs instrumentation or op-granularity checkpoint/
+            # resume the fastpath disengages and every run took the
+            # serial reference walk regardless of the request.
+            from tpusim.fastpath import resolve_backend
+            from tpusim.perf.cache import compiled_cache_stats
+
+            resolved = resolve_backend(self.pricing_backend)
+            if obs.enabled or cfg.resume_op or cfg.checkpoint_op:
+                resolved = "serial"
+            report.stats.set("fastpath_backend", resolved)
+            report.stats.update(compiled_cache_stats(), prefix="fastpath_")
         if fault_state is not None:
             # faults_* keys ride the report ONLY when a schedule is
             # active — the healthy path stays key-identical to PR 1.
@@ -659,6 +687,7 @@ def simulate_trace(
     validate: str | bool | None = None,
     result_cache=None,
     workers: int | None = None,
+    pricing_backend: str | None = None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -680,7 +709,10 @@ def simulate_trace(
     a directory path, or True for the default dir) memoizes engine
     results across runs; ``workers`` (``--workers`` /
     ``$TPUSIM_WORKERS``) fans module pricing over a process pool — both
-    bit-identical to the serial path."""
+    bit-identical to the serial path.  ``pricing_backend`` (the
+    ``--pricing-backend`` flag / ``$TPUSIM_PRICING_BACKEND``) pins the
+    tpusim.fastpath engine backend (auto/serial/vectorized/native; all
+    byte-identical) and stamps the ``fastpath_*`` stats block."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
@@ -720,4 +752,5 @@ def simulate_trace(
         return SimDriver(
             cfg, topology=topology, obs=obs, faults=faults,
             result_cache=result_cache, workers=workers,
+            pricing_backend=pricing_backend,
         ).run(pod)

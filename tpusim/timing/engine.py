@@ -17,7 +17,8 @@ chains) are recursed into and multiplied by the trip count XLA records in
 
 from __future__ import annotations
 
-from collections import defaultdict
+import threading
+from collections import OrderedDict, defaultdict
 from dataclasses import dataclass, field
 
 from tpusim.ici.collectives import CollectiveModel
@@ -368,17 +369,67 @@ def _vmem_peak_live_bytes(module: ModuleTrace) -> float:
     )
 
 
+#: process-wide memo for the per-module derived scalars (vmem residency
+#: and peak-live bytes), keyed under the module CONTENT hash: a fresh
+#: parse of the same text — a serve request re-registering a trace, an
+#: obs/windowed-fault replay that bypasses the result cache — skips the
+#: recursive walk entirely.  Object-attr caches stay as the L0 tier.
+_SCALAR_MEMO: OrderedDict = OrderedDict()
+_SCALAR_MEMO_MAX = 4096
+# the serving daemon prices from many request threads; the lock covers
+# the LRU mutations (a move_to_end racing an eviction raises KeyError)
+_SCALAR_MEMO_LOCK = threading.Lock()
+
+
+def _scalar_memo_key(module: ModuleTrace, kind: str) -> tuple | None:
+    h = module.meta.get("content_hash") if module.meta else None
+    if not h:
+        return None
+    return (str(h), kind)
+
+
+def _scalar_memo_get(key: tuple | None) -> float | None:
+    if key is None:
+        return None
+    with _SCALAR_MEMO_LOCK:
+        val = _SCALAR_MEMO.get(key)
+        if val is not None:
+            _SCALAR_MEMO.move_to_end(key)
+    return val
+
+
+def _scalar_memo_put(key: tuple | None, value: float) -> None:
+    if key is None:
+        return
+    with _SCALAR_MEMO_LOCK:
+        _SCALAR_MEMO[key] = value
+        _SCALAR_MEMO.move_to_end(key)
+        while len(_SCALAR_MEMO) > _SCALAR_MEMO_MAX:
+            _SCALAR_MEMO.popitem(last=False)
+
+
 def _residency_of(module: ModuleTrace) -> float:
     """Memoized vmem residency, cached ON the module (it is immutable
     after parse, and being an eq-based dataclass it is unhashable — no
-    dict keying).  The scan was ~30% of a small-module replay.  Lazy
-    modules provide a raw-text S(1) scan so the check doesn't force a
-    full parse."""
+    dict keying) and, when the module carries a content hash, in the
+    process-wide scalar memo (repeat parses of the same text skip the
+    scan).  The scan was ~30% of a small-module replay.  Lazy modules
+    provide a raw-text S(1) scan so the check doesn't force a full
+    parse."""
     cached = getattr(module, "_residency_cache", None)
     if cached is not None:
         return cached
     fast = getattr(module, "vmem_resident_bytes", None)
-    resident = fast() if callable(fast) else _vmem_resident_bytes(module)
+    # the raw-text scan (lazy/streaming modules) and the IR walk are
+    # deliberately different approximations — memoize them as distinct
+    # kinds so the value a module sees never depends on which
+    # representation of the same text priced first
+    kind = "resident_text" if callable(fast) else "resident_ir"
+    key = _scalar_memo_key(module, kind)
+    resident = _scalar_memo_get(key)
+    if resident is None:
+        resident = fast() if callable(fast) else _vmem_resident_bytes(module)
+        _scalar_memo_put(key, resident)
     try:
         module._residency_cache = resident
     except (AttributeError, TypeError):
@@ -399,10 +450,22 @@ class Engine:
         obs=None,
         clock_scale: float = 1.0,
         hbm_scale: float = 1.0,
+        pricing_backend: str | None = None,
     ):
         self.config = config
         self.arch = config.arch
         self.cost = cost_model or CostModel(self.arch)
+        # fastpath compile results are shared process-wide only for the
+        # default cost model (a caller-supplied model is outside every
+        # fingerprint — its compiled columns stay pinned to the module
+        # object + model token, mirroring the result-cache bypass)
+        self._default_cost_model = cost_model is None
+        # pricing backend (tpusim.fastpath): None/"auto" resolves to the
+        # fastest available path; "serial" pins the reference walk.
+        # Resolved lazily (first run) so Engine construction never pays
+        # a numpy import or a dlopen.
+        self.pricing_backend = pricing_backend
+        self._resolved_backend: str | None = None
         self.topology = topology
         self.record_timeline = record_timeline
         self.max_timeline_events = max_timeline_events
@@ -428,7 +491,11 @@ class Engine:
         cached = getattr(module, "_peak_live_cache", None)
         if cached is not None:
             return cached
-        peak = _vmem_peak_live_bytes(module)
+        key = _scalar_memo_key(module, "peak_live")
+        peak = _scalar_memo_get(key)
+        if peak is None:
+            peak = _vmem_peak_live_bytes(module)
+            _scalar_memo_put(key, peak)
         try:
             module._peak_live_cache = peak
         except (AttributeError, TypeError):
@@ -443,7 +510,30 @@ class Engine:
     # ------------------------------------------------------------------
 
     def run(self, module: ModuleTrace) -> EngineResult:
-        """Simulate one execution of the module's entry computation."""
+        """Simulate one execution of the module's entry computation.
+
+        Dispatches to the compiled fastpath (tpusim.fastpath) when a
+        non-serial backend is available and the run carries no
+        run-scoped observables; the serial walk below is the reference
+        semantics both fastpath backends are byte-identical to (pinned
+        by tests/test_fastpath.py and the --fastpath-parity CI smoke).
+        """
+        backend = self._resolved_backend
+        if backend is None:
+            from tpusim.fastpath.price import resolve_backend
+
+            backend = self._resolved_backend = resolve_backend(
+                self.pricing_backend
+            )
+        if backend != "serial":
+            from tpusim.fastpath.price import fastpath_eligible, price_module
+
+            if fastpath_eligible(self):
+                return price_module(self, module, backend)
+        return self._run_serial(module)
+
+    def _run_serial(self, module: ModuleTrace) -> EngineResult:
+        """The reference per-op schedule walk."""
         topo = self._topology_for(module)
         coll = make_collective_model(topo, self.arch.ici, obs=self.obs)
         result = EngineResult()

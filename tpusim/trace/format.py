@@ -254,41 +254,64 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
         with open(meta_path) as f:
             meta = json.load(f)
 
-    from tpusim.trace.lazy import LAZY_THRESHOLD_BYTES, parse_hlo_module_lazy
+    from tpusim.trace.lazy import (
+        LAZY_THRESHOLD_BYTES,
+        STREAM_THRESHOLD_BYTES,
+        parse_hlo_module_lazy,
+        parse_hlo_module_streaming,
+    )
     from tpusim.trace.native import parse_hlo_module_fast
+
+    stream_threshold = int(os.environ.get(
+        "TPUSIM_STREAM_THRESHOLD", STREAM_THRESHOLD_BYTES
+    ))
 
     pod = PodTrace(meta=meta)
     modules_dir = path / "modules"
     if modules_dir.is_dir():
         import gzip
 
-        entries: list[tuple[str, str]] = []
+        # str entries are in-memory module text; Path entries are
+        # file-backed modules above the streaming threshold (priced
+        # computation-by-computation with bounded RSS — the text is
+        # never read whole).  Lenient salvage and gzipped modules stay
+        # in memory: per-line recovery and decompression both need the
+        # full text anyway.
+        entries: list[tuple[str, str | Path]] = []
         for mp in sorted(modules_dir.glob("*.hlo")):
-            entries.append((mp.stem, mp.read_text()))
+            if not lenient and mp.stat().st_size >= stream_threshold:
+                entries.append((mp.stem, mp))
+            else:
+                entries.append((mp.stem, mp.read_text()))
         for mp in sorted(modules_dir.glob("*.hlo.gz")):
             with gzip.open(mp, "rt") as f:
                 entries.append((mp.name[: -len(".hlo.gz")], f.read()))
-        for key, text in entries:
+        for key, src in entries:
             # large modules parse lazily: the engine only materializes the
             # computations its schedule walk actually reaches
-            if lenient:
+            if isinstance(src, Path):
+                # the streaming index pass computes the content hash
+                # (chunked) itself
+                mod = parse_hlo_module_streaming(src, name_hint=key)
+            elif lenient:
                 from tpusim.trace.hlo_text import parse_hlo_module
 
-                mod = parse_hlo_module(text, name_hint=key, strict=False)
-            elif len(text) >= LAZY_THRESHOLD_BYTES:
-                mod = parse_hlo_module_lazy(text, name_hint=key)
+                mod = parse_hlo_module(src, name_hint=key, strict=False)
+            elif len(src) >= LAZY_THRESHOLD_BYTES:
+                mod = parse_hlo_module_lazy(src, name_hint=key)
             else:
-                mod = parse_hlo_module_fast(text, name_hint=key)
+                mod = parse_hlo_module_fast(src, name_hint=key)
             # file name is the trace key; HloModule header name may differ
             pod.modules[key] = mod
             mod.meta.setdefault("trace_key", key)
             # content digest of the module text — the address half of the
             # tpusim.perf result cache's key (computed here, where the
             # text is already in hand, so the cache never re-reads disk)
-            mod.meta.setdefault(
-                "content_hash",
-                hashlib.sha256(text.encode()).hexdigest()[:24],
-            )
+            if not isinstance(src, Path):
+                mod.meta.setdefault(
+                    "content_hash",
+                    hashlib.sha256(src.encode()).hexdigest()[:24],
+                )
             # capture-time facts (platform, device_kind) ride on every
             # module: the cost model gates capture-backend dtype
             # normalization on the platform the trace came from

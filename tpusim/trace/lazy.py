@@ -24,18 +24,62 @@ import re
 
 from tpusim.ir import FREE_OPCODES, ModuleTrace
 
-__all__ = ["LazyModuleTrace", "parse_hlo_module_lazy", "LAZY_THRESHOLD_BYTES"]
+__all__ = [
+    "LAZY_THRESHOLD_BYTES",
+    "LazyModuleTrace",
+    "STREAM_THRESHOLD_BYTES",
+    "StreamingModuleTrace",
+    "parse_hlo_module_lazy",
+    "parse_hlo_module_streaming",
+]
 
 #: load_trace switches to lazy parsing above this module-text size
 LAZY_THRESHOLD_BYTES = 8 * 1024 * 1024
 
 # a computation starts at a column-0 header: `%name (args) -> ... {` or
 # `ENTRY %name ...` (optionally fused/wrapped prefixes) and ends at the
-# next column-0 `}`
-_COMP_HEADER_RE = re.compile(
-    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[A-Za-z_][\w.\-]*)\s*\([^)]*\)\s*->",
+# next column-0 `}`.  The parameter list may contain NESTED parens
+# (tuple-typed parameters: `%body (arg: (s32[], bf16[...])) -> ...`)
+# and may wrap across lines, so the open is matched by regex and the
+# close by a balanced-paren scan (see _match_header).
+_COMP_HEAD_OPEN_RE = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[A-Za-z_][\w.\-]*)\s*\(",
     re.MULTILINE,
 )
+
+#: headers longer than this are not headers (balanced-scan cap)
+_HEADER_SCAN_CAP = 1 << 20
+
+
+def _match_header(text: str, start: int = 0):
+    """Does ``text[start:]`` begin a computation header
+    (``name(params) ->``, params possibly nested/multi-line)?
+
+    Returns ``(name, is_entry)`` on a confirmed header, ``None`` when
+    it definitely isn't one, or the string ``"partial"`` when the text
+    ends before the parameter list closes (a streaming caller should
+    buffer more lines and retry)."""
+    m = _COMP_HEAD_OPEN_RE.match(text, start)
+    if not m:
+        return None
+    depth = 0
+    limit = min(len(text), m.end() + _HEADER_SCAN_CAP)
+    for k in range(m.end() - 1, limit):
+        c = text[k]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                rest = text[k + 1:k + 64].lstrip()
+                if rest.startswith("->"):
+                    return m.group("name"), bool(m.group("entry"))
+                if not rest:
+                    # params closed at end-of-available-text: the ->
+                    # may be on a line the caller hasn't buffered yet
+                    return "partial"
+                return None
+    return "partial" if limit >= len(text) else None
 _MODULE_RE = re.compile(r"^HloModule\s+(?P<name>[\w.\-]+),?(?P<attrs>[^\n]*)")
 
 # defining lines whose result layout pins vmem: `= dtype[dims]{...S(n)...}`
@@ -115,13 +159,16 @@ class LazyModuleTrace(ModuleTrace):
             from tpusim.trace.hlo_text import parse_module_attrs
 
             parse_module_attrs(m.group("attrs") or "", self.meta)
-        for hm in _COMP_HEADER_RE.finditer(text):
+        for hm in _COMP_HEAD_OPEN_RE.finditer(text):
             # only column-0 headers open computations (ops are indented)
             if hm.start() > 0 and text[hm.start() - 1] != "\n":
                 continue
-            name = hm.group("name")
+            got = _match_header(text, hm.start())
+            if not isinstance(got, tuple):
+                continue
+            name, is_entry = got
             self._spans[name] = (hm.start(), _span_end(text, hm.start()))
-            if hm.group("entry"):
+            if is_entry:
                 self.entry_name = name
 
     @property
@@ -164,58 +211,332 @@ class LazyModuleTrace(ModuleTrace):
             self._spans.get(self.entry_name)
             if self.entry_name is not None else None
         )
-        total = 0.0
-        offset = 0  # running char offset: O(text) overall, no str.find
-        for line in self._text.splitlines(keepends=True):
-            idx = offset
-            offset += len(line)
-            dm = _VMEM_DEF_RE.search(line)
-            if not dm:
-                continue
-            op_m = _OPCODE_AFTER_SHAPE_RE.search(line)
-            opcode = op_m.group(1) if op_m else ""
-            in_entry = (
-                entry_span is not None
-                and entry_span[0] <= idx < entry_span[1]
-            )
-            if opcode in FREE_OPCODES:
-                # entry parameters are real allocations; nested ones alias
-                if opcode != "parameter" or not in_entry:
-                    continue
-            if opcode in ("while", "conditional") or opcode.endswith("-done"):
-                continue
-            if opcode == "dynamic-update-slice" and not in_entry:
-                continue
-            # the opcode regex anchors on the result's closing brace —
-            # keep it in the slice so the shape regex still matches
-            result_side = line[:op_m.start() + 1] if op_m else line
-            leaf_bytes = []
-            for sm in _VMEM_SHAPE_RE.finditer(result_side):
-                elems = 1
-                dims = sm.group("dims").strip()
-                if dims:
-                    for d in dims.split(","):
-                        try:
-                            elems *= int(d)
-                        except ValueError:
-                            elems = 0
-                            break
-                leaf_bytes.append(
-                    elems * _DTYPE_BYTES.get(sm.group("dtype"), 4)
-                )
-            if opcode == "copy-start":
-                # result is (dst, src-alias, ctx): dst leads
-                total += leaf_bytes[0] if leaf_bytes else 0.0
-            elif opcode.endswith("-start"):
-                # collective starts carry (operand-alias, result, ...):
-                # count one buffer, not the alias pair
-                total += max(leaf_bytes, default=0.0)
-            else:
-                total += sum(leaf_bytes)
-        return total
+
+        def lines():
+            offset = 0  # running char offset: O(text), no str.find
+            for line in self._text.splitlines(keepends=True):
+                yield offset, line
+                offset += len(line)
+
+        return _residency_scan(lines(), entry_span)
 
 
 def parse_hlo_module_lazy(
     text: str, name_hint: str = "module"
 ) -> LazyModuleTrace:
     return LazyModuleTrace(text, name_hint=name_hint)
+
+
+def _residency_scan(lines, entry_span: tuple[int, int] | None) -> float:
+    """The S(1) residency line scan shared by the in-memory lazy module
+    and the file-backed streaming module; ``lines`` yields
+    ``(char_offset, line)`` pairs.  Alias rules mirror the engine's
+    ``_vmem_resident_bytes`` (see :meth:`LazyModuleTrace.
+    vmem_resident_bytes` for the full contract)."""
+    total = 0.0
+    for idx, line in lines:
+        dm = _VMEM_DEF_RE.search(line)
+        if not dm:
+            continue
+        op_m = _OPCODE_AFTER_SHAPE_RE.search(line)
+        opcode = op_m.group(1) if op_m else ""
+        in_entry = (
+            entry_span is not None
+            and entry_span[0] <= idx < entry_span[1]
+        )
+        if opcode in FREE_OPCODES:
+            # entry parameters are real allocations; nested ones alias
+            if opcode != "parameter" or not in_entry:
+                continue
+        if opcode in ("while", "conditional") or opcode.endswith("-done"):
+            continue
+        if opcode == "dynamic-update-slice" and not in_entry:
+            continue
+        # the opcode regex anchors on the result's closing brace —
+        # keep it in the slice so the shape regex still matches
+        result_side = line[:op_m.start() + 1] if op_m else line
+        leaf_bytes = []
+        for sm in _VMEM_SHAPE_RE.finditer(result_side):
+            elems = 1
+            dims = sm.group("dims").strip()
+            if dims:
+                for d in dims.split(","):
+                    try:
+                        elems *= int(d)
+                    except ValueError:
+                        elems = 0
+                        break
+            leaf_bytes.append(
+                elems * _DTYPE_BYTES.get(sm.group("dtype"), 4)
+            )
+        if opcode == "copy-start":
+            # result is (dst, src-alias, ctx): dst leads
+            total += leaf_bytes[0] if leaf_bytes else 0.0
+        elif opcode.endswith("-start"):
+            # collective starts carry (operand-alias, result, ...):
+            # count one buffer, not the alias pair
+            total += max(leaf_bytes, default=0.0)
+        else:
+            total += sum(leaf_bytes)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Streaming (file-backed) modules — bounded-RSS pricing for multi-GB pods
+# ---------------------------------------------------------------------------
+
+#: load_trace switches from in-memory lazy parsing to file-backed
+#: streaming above this module-text size (override with
+#: $TPUSIM_STREAM_THRESHOLD; plain .hlo files only — gzipped modules
+#: decompress to memory and take the lazy path)
+STREAM_THRESHOLD_BYTES = 64 * 1024 * 1024
+
+#: substrings whose presence makes a module's price topology-dependent
+#: (mirror of tpusim.perf.cache._COLLECTIVE_MARKERS, scanned during the
+#: index pass so the result cache never forces a full parse)
+_ICI_MARKERS = (
+    b"all-reduce", b"all-gather", b"reduce-scatter", b"all-to-all",
+    b"collective-permute", b"collective-broadcast",
+)
+_ICI_OVERLAP = max(len(m) for m in _ICI_MARKERS) - 1
+
+_INDEX_CHUNK = 4 * 1024 * 1024
+
+_libc = None
+_libc_tried = False
+
+
+def _malloc_trim() -> None:
+    """Best-effort glibc heap trim (no-op off glibc/Linux)."""
+    global _libc, _libc_tried
+    if not _libc_tried:
+        _libc_tried = True
+        try:
+            import ctypes
+
+            lib = ctypes.CDLL("libc.so.6", use_errno=False)
+            lib.malloc_trim.argtypes = [ctypes.c_size_t]
+            _libc = lib
+        except (OSError, AttributeError):
+            _libc = None
+    if _libc is not None:
+        try:
+            _libc.malloc_trim(0)
+        except OSError:
+            pass
+
+
+class _StreamingComputationDict(_LazyComputationDict):
+    """Parse-on-demand with bounded retention: at most ``cap`` parsed
+    computations stay resident; the oldest parse is dropped when a new
+    one would exceed it (spans persist, so an evicted computation simply
+    re-parses on its next access)."""
+
+    def __init__(self, module: "StreamingModuleTrace", cap: int):
+        super().__init__(module)
+        self._cap = max(int(cap), 1)
+
+    def __missing__(self, key: str):
+        comp = super().__missing__(key)
+        while dict.__len__(self) > self._cap:
+            oldest = next(dict.__iter__(self))
+            if oldest == key:
+                break
+            dict.__delitem__(self, oldest)
+        return comp
+
+
+class StreamingModuleTrace(ModuleTrace):
+    """A ModuleTrace backed by an on-disk HLO file.
+
+    One chunked pass over the file builds the computation span index,
+    the content hash, and the ICI-marker flag **without ever holding the
+    full text**; computations parse on demand by seeking their span and
+    at most ``parsed_cap`` stay resident.  The fastpath prices such
+    modules *lean* (``stream_lean``): each reached computation is
+    compiled to flat columns and its parsed IR released immediately, so
+    peak RSS is bounded by the span index + columns + a handful of
+    parsed computations — far below the trace size."""
+
+    #: marks this module for lean fastpath compilation (per-op
+    #: aggregates dropped — their name table is the O(trace) term)
+    stream_lean = True
+
+    def __init__(self, path, name_hint: str = "module",
+                 parsed_cap: int = 8):
+        import hashlib
+
+        super().__init__(name=name_hint)
+        self._path = str(path)
+        self._spans: dict[str, tuple[int, int]] = {}
+        self.computations = _StreamingComputationDict(self, parsed_cap)
+
+        h = hashlib.sha256()
+        uses_ici = False
+        self._open_name: str | None = None
+        self._open_start = 0
+        self._header_seen = False
+        # multi-line computation headers (long parameter lists wrap):
+        # a column-0 line that *starts* like a header but doesn't match
+        # the full pattern buffers continuation lines until the pattern
+        # completes (mirrors the in-memory regex, whose [^)]* spans
+        # newlines)
+        self._pending: str | None = None
+        self._pending_start = 0
+        offset = 0
+        with open(self._path, "rb") as f:
+            carry = b""
+            while True:
+                chunk = f.read(_INDEX_CHUNK)
+                if not chunk:
+                    break
+                h.update(chunk)
+                if not uses_ici:
+                    uses_ici = any(
+                        m in carry[-_ICI_OVERLAP:] + chunk
+                        for m in _ICI_MARKERS
+                    )
+                buf = carry + chunk
+                lines = buf.split(b"\n")
+                carry = lines.pop()  # partial trailing line
+                for raw in lines:
+                    self._index_line(raw, offset)
+                    offset += len(raw) + 1
+            if carry:
+                self._index_line(carry, offset)
+                offset += len(carry)
+            if self._open_name is not None:
+                # unterminated final computation: span to EOF
+                self._spans[self._open_name] = (self._open_start, offset)
+        del self._pending, self._pending_start
+        del self._open_name, self._open_start, self._header_seen
+        self.meta.setdefault("content_hash", h.hexdigest()[:24])
+        self._uses_ici_cache = uses_ici
+
+    #: a header's parameter list may wrap, but not without bound — drop
+    #: a pending candidate past this many buffered chars (not a header)
+    _PENDING_CAP = 1 << 20
+
+    def _index_line(self, raw: bytes, offset: int) -> None:
+        """One line of the index pass (state machine over column-0
+        structure; ``raw`` has no trailing newline)."""
+        if self._pending is not None:
+            self._pending += "\n" + raw.decode("utf-8", errors="replace")
+            got = _match_header(self._pending)
+            if isinstance(got, tuple):
+                self._open_name, is_entry = got[0], got[1]
+                self._open_start = self._pending_start
+                if is_entry:
+                    self.entry_name = self._open_name
+                self._pending = None
+            elif got is None or len(self._pending) > self._PENDING_CAP:
+                # the parameter list closed without the header pattern
+                # completing (or grew absurd): not a computation header
+                self._pending = None
+            return
+        if not self._header_seen and raw.startswith(b"HloModule"):
+            self._header_seen = True
+            m = _MODULE_RE.match(raw.decode("utf-8", errors="replace"))
+            if m:
+                self.name = m.group("name")
+                from tpusim.trace.hlo_text import parse_module_attrs
+
+                parse_module_attrs(m.group("attrs") or "", self.meta)
+        elif raw[:1] == b"}":
+            if self._open_name is not None:
+                self._spans[self._open_name] = (
+                    self._open_start, offset + len(raw),
+                )
+                self._open_name = None
+        elif raw[:1] not in (b"", b" ", b"\t"):
+            text = raw.decode("utf-8", errors="replace")
+            got = _match_header(text)
+            if isinstance(got, tuple):
+                self._open_name, is_entry = got[0], got[1]
+                self._open_start = offset
+                if is_entry:
+                    self.entry_name = self._open_name
+            elif got == "partial":
+                self._pending = text
+                self._pending_start = offset
+
+    @property
+    def parsed_count(self) -> int:
+        return dict.__len__(self.computations)
+
+    _releases = 0
+
+    def release_computation(self, name: str) -> None:
+        """Drop a parsed computation's IR (the fastpath calls this right
+        after compiling it to columns; the span survives, so a later
+        access simply re-parses).  Every few releases the glibc heap is
+        trimmed: parse churn routes the >512-byte metadata strings
+        through malloc, whose freed chunks otherwise sit in arena free
+        lists and count against the bounded-RSS contract."""
+        if dict.__contains__(self.computations, name):
+            dict.__delitem__(self.computations, name)
+        self._releases += 1
+        if self._releases % 8 == 0:
+            _malloc_trim()
+
+    def _read_span(self, span: tuple[int, int]) -> str:
+        with open(self._path, "rb") as f:
+            f.seek(span[0])
+            return f.read(span[1] - span[0]).decode(
+                "utf-8", errors="replace"
+            )
+
+    def _parse_span(self, name: str, span: tuple[int, int]):
+        from tpusim.trace.native import parse_hlo_module_fast
+
+        fragment = (
+            "HloModule __lazy_fragment__\n\n" + self._read_span(span)
+        )
+        sub = parse_hlo_module_fast(fragment, name_hint="__lazy_fragment__")
+        comp = sub.computations.get(name)
+        if comp is None:
+            comps = list(sub.computations.values())
+            if len(comps) != 1:
+                raise KeyError(
+                    f"streaming parse of {name!r} produced {len(comps)} "
+                    f"computations"
+                )
+            comp = comps[0]
+        comp.is_entry = name == self.entry_name
+        return comp
+
+    def vmem_resident_bytes(self) -> float:
+        """Chunk-streamed S(1) residency scan (same contract as the
+        in-memory lazy scan; the file is read once, never held)."""
+        entry_span = (
+            self._spans.get(self.entry_name)
+            if self.entry_name is not None else None
+        )
+
+        def lines():
+            offset = 0
+            with open(self._path, "rb") as f:
+                carry = b""
+                while True:
+                    chunk = f.read(_INDEX_CHUNK)
+                    if not chunk:
+                        break
+                    buf = carry + chunk
+                    parts = buf.split(b"\n")
+                    carry = parts.pop()
+                    for raw in parts:
+                        yield offset, raw.decode(
+                            "utf-8", errors="replace"
+                        )
+                        offset += len(raw) + 1
+                if carry:
+                    yield offset, carry.decode("utf-8", errors="replace")
+
+        return _residency_scan(lines(), entry_span)
+
+
+def parse_hlo_module_streaming(
+    path, name_hint: str = "module", parsed_cap: int = 8
+) -> StreamingModuleTrace:
+    return StreamingModuleTrace(path, name_hint=name_hint,
+                                parsed_cap=parsed_cap)
