@@ -402,7 +402,27 @@ def perf_smoke() -> dict:
     }
 
 
-def serve_smoke() -> dict:
+def _serve_golden_bytes(name: str) -> str:
+    """One committed golden artifact, raw bytes-as-text — the anchor
+    both serve smokes compare served responses against."""
+    path = GOLDEN_DIR / f"{name}.json"
+    if not path.exists():
+        raise ValueError(f"no golden file {path} (run --update)")
+    return path.read_text()
+
+
+def _serve_served_bytes(stats: dict) -> str:
+    """A served stats doc rendered EXACTLY as the golden writer renders
+    the CLI's (volatile + perf-accounting keys stripped, same dumps
+    args) — the one canon both serve smokes must enforce."""
+    doc = {
+        k: v for k, v in stats.items()
+        if k not in VOLATILE and not k.startswith(PERF_KEY_PREFIXES)
+    }
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+def serve_smoke(serve_workers: int = 0) -> dict:
     """Serving-layer determinism contract (tpusim.serve):
 
     1. a daemon booted on a free loopback port, serving the committed
@@ -410,10 +430,18 @@ def serve_smoke() -> dict:
        stats doc BYTE-IDENTICAL to the committed CLI golden (same
        JSON serialization, volatile + perf-accounting keys stripped);
     2. a warm second pass over the same requests must serve every
-       response from the shared result cache: ``cache_hit`` true on
-       each and ZERO engine pricing walks anywhere in the process;
+       response from the result cache: ``cache_hit`` true on each and
+       ZERO engine pricing walks anywhere in the process;
     3. ``/metrics`` must parse as Prometheus text and carry the serve
        counters; ``/healthz`` must be ok; the drain must complete.
+
+    ``serve_workers > 0`` runs the same contract through the serve v2
+    supervised pre-forked pool — the byte-identity claim across 1..N
+    workers.  There the engine-walk counter guards the PARENT process
+    over BOTH passes (every request must be priced by the pool, never
+    the in-process fallback) and the warm pass must be all ``cache_hit``
+    (content-hash affinity lands repeats on the worker whose L1 is warm;
+    a cache hit is by construction a request priced with zero walks).
     Raises on violation."""
     from tpusim.serve.client import ServeClient
     from tpusim.serve.daemon import ServeDaemon
@@ -426,18 +454,8 @@ def serve_smoke() -> dict:
         runs["n"] += 1
         return orig_run(self, module)
 
-    def golden_bytes(name: str) -> str:
-        path = GOLDEN_DIR / f"{name}.json"
-        if not path.exists():
-            raise ValueError(f"no golden file {path} (run --update)")
-        return path.read_text()
-
-    def served_bytes(stats: dict) -> str:
-        doc = {
-            k: v for k, v in stats.items()
-            if k not in VOLATILE and not k.startswith(PERF_KEY_PREFIXES)
-        }
-        return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    golden_bytes = _serve_golden_bytes
+    served_bytes = _serve_served_bytes
 
     def run_pass(client) -> list[tuple[str, dict, bool]]:
         out = []
@@ -453,13 +471,26 @@ def serve_smoke() -> dict:
             out.append((name, r.stats, r.cache_hit))
         return out
 
-    daemon = ServeDaemon(trace_root=FIXTURES, max_inflight=4)
+    daemon = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4,
+        serve_workers=max(int(serve_workers), 0),
+    )
+    if serve_workers > 0:
+        # the pool prices in child processes; the parent must price
+        # NOTHING in either pass — count from before the cold pass
+        Engine.run = counting_run
     daemon.start()
     try:
         client = ServeClient(daemon.url)
         health = client.healthz()
         if health.get("status") != "ok":
             raise ValueError(f"healthz not ok: {health}")
+        if serve_workers > 0:
+            if health.get("workers_alive") != serve_workers:
+                raise ValueError(
+                    f"expected {serve_workers} live workers, healthz "
+                    f"reports {health.get('workers_alive')}"
+                )
 
         cold = run_pass(client)
         for name, stats, _hit in cold:
@@ -468,19 +499,25 @@ def serve_smoke() -> dict:
             if got != want:
                 raise ValueError(
                     f"served stats for {name} diverged from the "
-                    f"committed CLI golden (byte comparison failed)"
+                    f"committed CLI golden (byte comparison failed, "
+                    f"serve_workers={serve_workers})"
                 )
 
-        Engine.run = counting_run
+        if serve_workers == 0:
+            Engine.run = counting_run
         try:
             warm = run_pass(client)
         finally:
             Engine.run = orig_run
         if runs["n"] != 0:
+            what = (
+                "the parent process still executed"
+                if serve_workers > 0 else "warm pass still executed"
+            )
             raise ValueError(
-                f"warm pass still executed {runs['n']} engine pricing "
-                f"walks (expected 0: every request must be served from "
-                f"the shared result cache)"
+                f"{what} {runs['n']} engine pricing walks (expected 0: "
+                f"every request must be served from the "
+                f"{'pool' if serve_workers > 0 else 'shared result cache'})"
             )
         missed = [name for name, _s, hit in warm if not hit]
         if missed:
@@ -505,9 +542,16 @@ def serve_smoke() -> dict:
                 raise ValueError(f"bad prometheus line: {line!r}")
             float(parts[1])
             gauges += 1
-        for required in ("serve_requests_total", "serve_cache_hits"):
-            if f"tpusim_{required} " not in prom:
-                raise ValueError(f"/metrics missing {required}")
+        required = ["serve_requests_total", "serve_cache_hits"]
+        if serve_workers > 0:
+            required += [
+                "serve_workers_alive", "serve_worker_restarts_total",
+                "serve_worker_kills_total", "serve_quarantine_size",
+                "serve_shed_503_total",
+            ]
+        for key in required:
+            if f"tpusim_{key} " not in prom:
+                raise ValueError(f"/metrics missing {key}")
     finally:
         Engine.run = orig_run
         if not daemon.drain_and_stop():
@@ -516,6 +560,96 @@ def serve_smoke() -> dict:
         "configs": len(cold),
         "warm_cache_hits": len(warm),
         "gauges": gauges,
+        "serve_workers": max(int(serve_workers), 0),
+    }
+
+
+def serve_chaos_smoke(serve_workers: int = 2) -> dict:
+    """Serve v2 survivability contract: SIGKILL a worker while the
+    golden matrix is in flight and the run must still finish green —
+
+    1. ZERO failed requests: the killed worker's request is retried on
+       a fresh worker and every response (including the retried one)
+       stays byte-identical to the committed CLI goldens;
+    2. at least one worker restart is recorded by the supervisor (the
+       kill really landed, the fleet really healed);
+    3. the daemon drains cleanly afterwards.
+    Raises on violation."""
+    import threading
+
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    golden_bytes = _serve_golden_bytes
+    served_bytes = _serve_served_bytes
+
+    daemon = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4, serve_workers=serve_workers,
+    )
+    daemon.start()
+    sup = daemon.supervisor
+    stop_chaos = threading.Event()
+    killed = {"pid": None}
+
+    def chaos():
+        # wait for a request to be mid-flight on some worker, then
+        # SIGKILL that worker exactly once — the worst-timed crash
+        while not stop_chaos.is_set():
+            for slot in sup.slots:
+                if slot.busy and slot.pid is not None:
+                    killed["pid"] = slot.pid
+                    sup.kill_worker(slot.index)
+                    return
+            stop_chaos.wait(0.002)
+
+    chaos_thread = threading.Thread(target=chaos, daemon=True)
+    try:
+        client = ServeClient(daemon.url)
+        chaos_thread.start()
+        failures = []
+        passes = 2  # enough traffic that the kill lands mid-pass
+        for _ in range(passes):
+            for fixture, arch, overlays in MATRIX:
+                name = f"{fixture}__{arch}"
+                tag = _overlay_tag(overlays)
+                if tag:
+                    name += "__" + tag
+                try:
+                    r = client.simulate(
+                        trace=fixture, arch=arch,
+                        overlays=list(overlays), tuned=False,
+                    )
+                except Exception as e:  # noqa: BLE001 - the contract
+                    failures.append(f"{name}: {type(e).__name__}: {e}")
+                    continue
+                if served_bytes(r.stats) != golden_bytes(name):
+                    failures.append(f"{name}: stats diverged from golden")
+        stop_chaos.set()
+        chaos_thread.join(timeout=5.0)
+        if killed["pid"] is None:
+            raise ValueError(
+                "chaos kill never landed (no worker was ever observed "
+                "busy — did the pool serve anything?)"
+            )
+        if failures:
+            raise ValueError(
+                f"{len(failures)} request(s) failed after the worker "
+                f"kill: {failures[:4]}"
+            )
+        restarts = sum(s.restarts for s in sup.slots)
+        if restarts < 1:
+            raise ValueError(
+                "worker was killed but the supervisor recorded no restart"
+            )
+    finally:
+        stop_chaos.set()
+        if not daemon.drain_and_stop():
+            raise ValueError("daemon did not drain cleanly after chaos")
+    return {
+        "configs": len(MATRIX) * passes,
+        "killed_pid": killed["pid"],
+        "restarts": restarts,
+        "retries": sup.retried,
     }
 
 
@@ -870,7 +1004,18 @@ def main(argv: list[str] | None = None) -> int:
                          "the golden-matrix requests over HTTP: stats "
                          "docs must be byte-identical to the committed "
                          "CLI goldens, and a warm second pass must "
-                         "report cache_hit with zero engine walks")
+                         "report cache_hit with zero engine walks; runs "
+                         "both the single-process daemon and the serve "
+                         "v2 supervised multi-worker pool")
+    ap.add_argument("--serve-chaos-smoke", action="store_true",
+                    help="SIGKILL a supervised worker while the golden "
+                         "matrix is in flight: the run must finish with "
+                         "zero failed requests, every response still "
+                         "byte-identical to the committed goldens, and "
+                         "at least one recorded worker restart")
+    ap.add_argument("--serve-workers", type=int, default=2, metavar="N",
+                    help="worker count for the multi-worker serve legs "
+                         "(default 2)")
     ap.add_argument("--advise-smoke", action="store_true",
                     help="run the fixed-spec sharding-advisor sweep on "
                          "the llama_tiny fixture: the ranked report "
@@ -938,16 +1083,37 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.serve_smoke:
+        # both daemon shapes carry the same contract: the PR 5
+        # single-process path, then the serve v2 supervised pool
+        for workers in (0, max(args.serve_workers, 1)):
+            leg = f"serve_workers={workers}"
+            try:
+                summary = serve_smoke(serve_workers=workers)
+            except (ValueError, OSError, KeyError) as e:
+                print(f"ci/check_golden --serve-smoke [{leg}]: "
+                      f"FAILED: {e}")
+                return 1
+            print(f"ci/check_golden --serve-smoke [{leg}]: OK "
+                  f"({summary['configs']} served configs byte-identical "
+                  f"to CLI goldens; warm pass "
+                  f"{summary['warm_cache_hits']}/{summary['configs']} "
+                  f"cache_hit with zero engine walks; "
+                  f"{summary['gauges']} prometheus gauges)")
+        return 0
+
+    if args.serve_chaos_smoke:
         try:
-            summary = serve_smoke()
+            summary = serve_chaos_smoke(
+                serve_workers=max(args.serve_workers, 2),
+            )
         except (ValueError, OSError, KeyError) as e:
-            print(f"ci/check_golden --serve-smoke: FAILED: {e}")
+            print(f"ci/check_golden --serve-chaos-smoke: FAILED: {e}")
             return 1
-        print(f"ci/check_golden --serve-smoke: OK ({summary['configs']} "
-              f"served configs byte-identical to CLI goldens; warm pass "
-              f"{summary['warm_cache_hits']}/{summary['configs']} "
-              f"cache_hit with zero engine walks; "
-              f"{summary['gauges']} prometheus gauges)")
+        print(f"ci/check_golden --serve-chaos-smoke: OK "
+              f"({summary['configs']} requests green through a "
+              f"mid-run SIGKILL of worker pid {summary['killed_pid']}; "
+              f"{summary['restarts']} worker restart(s), "
+              f"{summary['retries']} request retry(ies))")
         return 0
 
     if args.perf_smoke:

@@ -40,29 +40,36 @@
 #                served stats docs must be byte-identical to the
 #                committed CLI goldens, and a warm second pass must
 #                report cache_hit on every response with zero engine
-#                pricing walks
-#  11. campaign — campaign-layer determinism: a fixed-seed 16-scenario
+#                pricing walks — run through BOTH daemon shapes: the
+#                single-process path and the serve v2 supervised
+#                multi-worker pool (byte-identity across 1..N workers)
+#  11. serve-chaos — serve v2 survivability: SIGKILL a supervised
+#                worker while the golden matrix is in flight; the run
+#                must finish with zero failed requests (the killed
+#                request retried on a fresh worker, still golden) and
+#                at least one recorded worker restart
+#  12. campaign — campaign-layer determinism: a fixed-seed 16-scenario
 #                Monte-Carlo compound-fault campaign on the llama_tiny
 #                fixture must reproduce the committed report
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  12. advise  — sharding-advisor determinism: a fixed-spec strategy
+#  13. advise  — sharding-advisor determinism: a fixed-spec strategy
 #                sweep on the llama_tiny fixture must reproduce the
 #                committed ranked report byte-for-byte (step-time/
 #                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  13. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  14. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-12
+# Usage:  bash ci/run_ci.sh            # tiers 1-13
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/13] build native from source (+ native parity suite) ==="
+echo "=== [1/14] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -76,44 +83,47 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/13] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/14] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/13] unit tests (fast tier) ==="
+echo "=== [3/14] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/13] golden-stat regression sims ==="
+echo "=== [4/14] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/13] obs export smoke (schema-checked) ==="
+echo "=== [5/14] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/13] faults smoke (degraded-pod contract) ==="
+echo "=== [6/14] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/13] trace/config/schedule lint smoke ==="
+echo "=== [7/14] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/13] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/14] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/13] fastpath parity (pricing-backend byte-identity) ==="
+echo "=== [9/14] fastpath parity (pricing-backend byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/13] serve smoke (HTTP daemon determinism) ==="
+echo "=== [10/14] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/13] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [11/14] serve chaos smoke (worker SIGKILL survivability) ==="
+python ci/check_golden.py --serve-chaos-smoke
+
+echo "=== [12/14] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [12/13] advise smoke (sharding-advisor determinism) ==="
+echo "=== [13/14] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [13/13] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [14/14] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [13/13] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [14/14] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

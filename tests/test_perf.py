@@ -535,3 +535,35 @@ def test_pool_sigterm_drains_in_flight_work(tmp_path):
     else:
         os.killpg(proc.pid, signal.SIGKILL)
         raise AssertionError("orphan pool workers outlived the parent")
+
+
+def test_durable_disk_cache_fsyncs_before_replace(tmp_path, monkeypatch):
+    """durable=True (the serve v2 worker fleet's L2 mode) must fsync the
+    record AND its directory entry before the atomic publish — a worker
+    killed mid-publish (or a host dying under the pool) can then never
+    leave a short-read record for every later reader to warn about.
+    The default mode must not pay the fsyncs."""
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+
+    fsyncs: list[int] = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (fsyncs.append(fd), real_fsync(fd))[1],
+    )
+
+    plain = ResultCache(disk_dir=tmp_path / "plain")
+    CachedEngine(cfg, result_cache=plain).run(mod)
+    assert fsyncs == [], "non-durable mode paid fsyncs"
+    assert plain.durable is False
+
+    durable = ResultCache(disk_dir=tmp_path / "durable", durable=True)
+    r1 = CachedEngine(cfg, result_cache=durable).run(mod)
+    # one for the record file, one for the directory entry
+    assert len(fsyncs) == 2
+    # and the durable record round-trips exactly
+    c2 = ResultCache(disk_dir=tmp_path / "durable", durable=True)
+    r2 = CachedEngine(cfg, result_cache=c2).run(mod)
+    assert c2.disk_hits == 1
+    assert r2.cycles == r1.cycles

@@ -19,8 +19,11 @@ Covers the serving contract the subsystem promises:
 
 from __future__ import annotations
 
+import http.client
 import json
+import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -457,3 +460,322 @@ def test_in_process_drain_rejects_new_work():
         assert ei.value.doc.get("status") == "draining"
     finally:
         d.drain_and_stop()
+
+
+# ---------------------------------------------------------------------------
+# serve v2: supervised worker pool — chaos paths
+# ---------------------------------------------------------------------------
+#
+# These tests exercise the supervision policies with the daemon's
+# chaos hooks armed (``chaos_hooks=True``: ``_chaos_exit`` makes the
+# worker ``os._exit`` the instant the request lands, ``_chaos_sleep_s``
+# stalls it before pricing — a stand-in for a hung native call).  The
+# invariant under test throughout: one bad request costs exactly one
+# worker, never the service, and every surviving response stays
+# byte-identical to the single-process path.
+
+
+def _raw_post(daemon, path, body, timeout=60.0):
+    """POST without the typed client: chaos bodies carry hook keys the
+    client API (rightly) has no parameter for, and some assertions need
+    the raw status + headers."""
+    conn = http.client.HTTPConnection(daemon.host, daemon.port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        payload = resp.read()
+        return resp.status, dict(resp.getheaders()), json.loads(payload)
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def pool_daemon(tmp_path_factory):
+    # Workers pin their environment AT FORK TIME.  This module-scoped
+    # fixture is created before the function-scoped autouse
+    # ``_isolate_tuned_overlays`` patch, so without pinning here the
+    # fleet would fork with the repo's tuned overlays visible while the
+    # in-process daemons compose lazily under the per-test isolation —
+    # and "byte-identical" would fail on a config skew, not a pool bug.
+    old = os.environ.get("TPUSIM_TUNED_DIR")
+    os.environ["TPUSIM_TUNED_DIR"] = str(
+        tmp_path_factory.mktemp("no_tuned_pool")
+    )
+    d = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4, serve_workers=2,
+        chaos_hooks=True,
+    ).start()
+    try:
+        yield d
+    finally:
+        d.drain_and_stop()
+        if old is None:
+            os.environ.pop("TPUSIM_TUNED_DIR", None)
+        else:
+            os.environ["TPUSIM_TUNED_DIR"] = old
+
+
+@pytest.fixture(scope="module")
+def pool_client(pool_daemon):
+    return ServeClient(pool_daemon.url)
+
+
+def test_multi_worker_byte_identical_to_single_process(pool_client, client):
+    """The byte-identity contract across 1..N workers: the supervised
+    pool's stats docs equal the single-process daemon's for the same
+    requests (the CI serve smoke extends this to the full golden
+    matrix)."""
+    for trace, arch in (("llama_tiny_tp2dp2", "v5p"), ("matmul_512", "v5e")):
+        multi = pool_client.simulate(trace=trace, arch=arch)
+        single = client.simulate(trace=trace, arch=arch)
+        assert canonical(multi.stats) == canonical(single.stats)
+
+
+def test_healthz_and_metrics_expose_worker_fleet(pool_client):
+    health = pool_client.healthz()
+    assert health["workers_configured"] == 2
+    assert health["workers_alive"] >= 1
+    docs = health["workers"]
+    assert len(docs) == 2
+    assert {d["index"] for d in docs} == {0, 1}
+    for key in ("alive", "pid", "restarts", "kills", "crashes"):
+        assert key in docs[0]
+    prom = pool_client.metrics_text()
+    for gauge in (
+        "serve_workers_alive", "serve_worker_restarts_total",
+        "serve_worker_kills_total", "serve_quarantine_size",
+        "serve_shed_503_total",
+    ):
+        assert f"tpusim_{gauge} " in prom
+
+
+def test_sigkilled_worker_mid_request_is_retried_byte_identical(
+    pool_daemon, pool_client, client,
+):
+    """The headline chaos path: SIGKILL the worker while it holds a
+    request.  The daemon survives, the request is retried on a fresh
+    worker and answers 200 with stats byte-identical to the
+    single-process baseline, and the supervisor records the restart."""
+    sup = pool_daemon.supervisor
+    baseline = client.simulate(trace="matmul_512", arch="v5e")
+    restarts0 = sum(s.restarts for s in sup.slots)
+    retried0 = sup.retried
+    out = {}
+
+    def go():
+        out["resp"] = _raw_post(pool_daemon, "/v1/simulate", {
+            "trace": "matmul_512", "arch": "v5e",
+            "_chaos_sleep_s": 1.0,  # a window to land the SIGKILL in
+        })
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    victim = None
+    deadline = time.monotonic() + 5.0
+    while victim is None and time.monotonic() < deadline:
+        for s in sup.slots:
+            if s.busy and s.pid is not None:
+                victim = s.pid
+                break
+        time.sleep(0.01)
+    assert victim is not None, "request never reached a worker"
+    os.kill(victim, signal.SIGKILL)
+    t.join(timeout=60.0)
+    assert not t.is_alive(), "request never completed after the kill"
+    status, _headers, doc = out["resp"]
+    assert status == 200, doc
+    assert canonical(doc["stats"]) == canonical(baseline.stats)
+    assert sup.retried == retried0 + 1
+    # the dead slot is respawned (poll: restart rides the monitor loop)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if (sum(s.restarts for s in sup.slots) >= restarts0 + 1
+                and sup.alive_count() == 2):
+            break
+        time.sleep(0.02)
+    assert sum(s.restarts for s in sup.slots) >= restarts0 + 1
+    assert sup.alive_count() == 2
+
+
+def test_poison_request_quarantined_after_retry_budget(
+    pool_daemon, pool_client,
+):
+    """A request that kills EVERY worker it lands on burns its retry
+    budget, then 422s with a diagnostic; the identical request is
+    refused immediately (no further worker deaths) and the pool keeps
+    serving clean traffic."""
+    sup = pool_daemon.supervisor
+    body = {"trace": "llama_tiny_tp2dp2", "arch": "v5p", "_chaos_exit": True}
+    status, _headers, doc = _raw_post(pool_daemon, "/v1/simulate", body)
+    assert status == 422, doc
+    assert doc["error"] == "poison_request"
+    assert doc["poison"]["worker_deaths"] == 2  # original + one retry
+    assert doc["poison"]["content_hash"]
+    # identical request again: quarantine answers, nobody dies
+    crashes0 = sum(s.crashes for s in sup.slots)
+    status2, _h2, doc2 = _raw_post(pool_daemon, "/v1/simulate", body)
+    assert status2 == 422 and doc2["error"] == "poison_request"
+    assert sum(s.crashes for s in sup.slots) == crashes0
+    # a different deadline is the same poison (volatile keys stripped
+    # from the quarantine identity)
+    status3, _h3, doc3 = _raw_post(
+        pool_daemon, "/v1/simulate", {**body, "deadline_ms": 9999},
+    )
+    assert status3 == 422 and doc3["error"] == "poison_request"
+    # the poison burned both workers; once the backed-off restarts land
+    # (what Retry-After tells a real client to wait for) the pool
+    # serves clean traffic again
+    deadline = time.monotonic() + 10.0
+    while sup.alive_count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sup.alive_count() == 2
+    r = pool_client.simulate(trace="llama_tiny_tp2dp2", arch="v5p")
+    assert r.sim_cycles > 0
+
+
+def test_deadline_kill_of_stuck_worker_504_and_restart(
+    pool_daemon, pool_client,
+):
+    """A worker stuck past the request deadline is killed (SIGTERM →
+    SIGKILL escalation), the request 504s, and the slot is restarted —
+    a hung native call can no longer pin the daemon."""
+    sup = pool_daemon.supervisor
+    kills0 = sum(s.kills for s in sup.slots)
+    status, _headers, doc = _raw_post(pool_daemon, "/v1/simulate", {
+        "trace": "matmul_512", "arch": "v5e",
+        "_chaos_sleep_s": 30.0, "deadline_ms": 400,
+    })
+    assert status == 504, doc
+    assert doc["error"] == "deadline_exceeded"
+    assert "killed" in doc["detail"]
+    assert sum(s.kills for s in sup.slots) == kills0 + 1
+    # the killed slot comes back and the pool keeps serving
+    deadline = time.monotonic() + 10.0
+    while sup.alive_count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sup.alive_count() == 2
+    assert pool_client.simulate(trace="matmul_512", arch="v5e").sim_cycles > 0
+
+
+def test_degraded_pool_sheds_load_503_with_retry_after():
+    """Once live workers fall below the floor the daemon sheds load
+    (503 + Retry-After) instead of queueing into a dead pool, and
+    /healthz reports the degraded state (200 — the daemon itself is
+    answering; balancers read the field)."""
+    d = ServeDaemon(
+        trace_root=FIXTURES, serve_workers=1, min_workers=1,
+        restart_backoff_s=5.0, chaos_hooks=True,
+    ).start()
+    try:
+        c = ServeClient(d.url)
+        assert c.simulate(trace="matmul_512", arch="v5e").sim_cycles > 0
+        d.supervisor.kill_worker(0)
+        deadline = time.monotonic() + 5.0
+        while d.supervisor.alive_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert d.supervisor.alive_count() == 0
+        status, headers, doc = _raw_post(
+            d, "/v1/simulate", {"trace": "matmul_512", "arch": "v5e"},
+        )
+        assert status == 503, doc
+        assert doc["error"] == "degraded"
+        assert int(headers.get("Retry-After", "0")) >= 1
+        assert d.supervisor.shed >= 1
+        health = c.healthz()
+        assert health["status"] == "degraded"
+        assert health["workers_alive"] == 0
+    finally:
+        d.drain_and_stop()
+
+
+def test_affinity_key_ignores_deadline_but_not_content():
+    from tpusim.serve.supervisor import Supervisor
+
+    a = Supervisor.affinity_key("simulate", {"trace": "x", "deadline_ms": 100})
+    b = Supervisor.affinity_key("simulate", {"trace": "x", "deadline_ms": 900})
+    other = Supervisor.affinity_key("simulate", {"trace": "y"})
+    assert a == b
+    assert a != other
+
+
+# ---------------------------------------------------------------------------
+# serve v2: client timeouts + safe retries
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def black_hole():
+    """A server that accepts connections and never answers — the
+    stalled-daemon stand-in."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    accepted = []
+
+    def acceptor():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            accepted.append(conn)
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    try:
+        yield srv.getsockname(), accepted
+    finally:
+        srv.close()
+        for conn in accepted:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def test_client_get_times_out_and_retries(black_hole):
+    """A stalled daemon can no longer block the client forever: the
+    call times out, the (idempotent) GET retries once with backoff,
+    and the failure surfaces as a typed 'timeout' error."""
+    (host, port), accepted = black_hole
+    c = ServeClient(
+        f"http://{host}:{port}", timeout_s=0.3, retries=1,
+        backoff_base_s=0.01,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(ServeError) as ei:
+        c.healthz()
+    assert ei.value.status == 0
+    assert ei.value.code == "timeout"
+    assert time.monotonic() - t0 < 5.0
+    # both the original attempt and the retry reached the server
+    assert len(accepted) == 2
+
+
+def test_client_never_replays_a_sent_post(black_hole):
+    """A POST whose bytes finished sending is NOT retried on timeout —
+    the server may have executed it (a replayed /v1/sweep would
+    enqueue a duplicate job)."""
+    (host, port), accepted = black_hole
+    c = ServeClient(
+        f"http://{host}:{port}", timeout_s=0.3, retries=3,
+        backoff_base_s=0.01,
+    )
+    with pytest.raises(ServeError) as ei:
+        c.simulate(trace="matmul_512", arch="v5e")
+    assert ei.value.code == "timeout"
+    assert len(accepted) == 1  # one attempt, no replay
+
+
+def test_client_per_call_timeout_override(black_hole):
+    """timeout_s= on a single call beats the constructor default, even
+    on a warm keep-alive connection."""
+    (host, port), _accepted = black_hole
+    c = ServeClient(f"http://{host}:{port}", timeout_s=60.0, retries=0)
+    t0 = time.monotonic()
+    with pytest.raises(ServeError):
+        c.healthz(timeout_s=0.25)
+    assert time.monotonic() - t0 < 5.0

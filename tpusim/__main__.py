@@ -551,6 +551,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         max_request_bytes=args.max_request_bytes,
         result_cache=args.result_cache,
         workers=args.workers or 1,
+        serve_workers=args.serve_workers,
+        min_workers=args.serve_min_workers,
         # clamp at 1: job_workers=0 is the in-process test hook (accept
         # + persist jobs without draining them); a served daemon must
         # always drain its queue
@@ -564,9 +566,14 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # the bound port line is the startup contract: --port 0 asks the
     # kernel for a free port, and wrappers (tests, serve-smoke, shell
     # scripts) parse this line to find it
+    workers_note = (
+        f", serve-workers {args.serve_workers}" if args.serve_workers
+        else ""
+    )
     print(f"tpusim serve: listening on http://{daemon.host}:{daemon.port} "
           f"(traces: {args.trace_root or 'inline only'}; "
-          f"max-inflight {args.max_inflight}, queue {args.queue_depth})",
+          f"max-inflight {args.max_inflight}, queue {args.queue_depth}"
+          f"{workers_note})",
           flush=True)
     daemon.wait_stopped()
     print("tpusim serve: drained, exiting", flush=True)
@@ -577,7 +584,9 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
     """Loadgen for the serving daemon: replay a fixture request mix at a
     target concurrency, report p50/p95/p99 + throughput, and compare the
     warm served path against the cold one-shot CLI."""
-    from tpusim.serve.bench import format_report, run_serve_bench
+    from tpusim.serve.bench import (
+        format_report, format_sweep, run_serve_bench, run_worker_sweep,
+    )
 
     mix = None
     if args.trace:
@@ -585,20 +594,45 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             {"trace": t, "arch": args.arch}
             for t in args.trace
         ]
-    doc = run_serve_bench(
-        url=args.url,
-        trace_root=args.trace_root,
-        concurrency=args.concurrency,
-        requests=args.requests,
-        mix=mix,
-        cli_baseline=not args.no_cli_baseline,
-    )
-    print(format_report(doc))
+    if args.worker_sweep:
+        try:
+            counts = [int(c) for c in args.worker_sweep.split(",") if c]
+        except ValueError:
+            print(f"tpusim serve-bench: --worker-sweep wants a comma-"
+                  f"separated int list, got {args.worker_sweep!r}")
+            return 2
+        doc = run_worker_sweep(
+            worker_counts=counts,
+            trace_root=args.trace_root,
+            concurrency=args.concurrency,
+            requests=args.requests,
+            mix=mix,
+            cli_baseline=not args.no_cli_baseline,
+            reps=args.reps,
+        )
+        print(format_sweep(doc))
+        failed = any(
+            leg["error_count"] for leg in doc["worker_sweep"]
+        )
+    else:
+        doc = run_serve_bench(
+            url=args.url,
+            trace_root=args.trace_root,
+            concurrency=args.concurrency,
+            requests=args.requests,
+            mix=mix,
+            cli_baseline=not args.no_cli_baseline,
+            serve_workers=args.serve_workers,
+            reps=args.reps,
+        )
+        print(format_report(doc))
+        failed = bool(doc.get("error_count") or doc.get("errors"))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=2)
+            f.write("\n")
         print(f"report written to {args.json}")
-    return 1 if doc.get("errors") else 0
+    return 1 if failed else 0
 
 
 def _cmd_lint(args: argparse.Namespace) -> int:
@@ -1264,6 +1298,18 @@ def main(argv: list[str] | None = None) -> int:
                      help="per-request pricing workers (default 1: "
                           "process pools and threaded serving don't mix "
                           "unless you know your start method)")
+    psv.add_argument("--serve-workers", type=int, default=0, metavar="N",
+                     help="serve v2: pre-forked supervised worker "
+                          "processes for sync pricing — crash isolation "
+                          "(one bad request costs one worker), deadline "
+                          "kills, poison-request quarantine, content-"
+                          "hash-affinity dispatch (default 0: the "
+                          "single-process path)")
+    psv.add_argument("--serve-min-workers", type=int, default=1,
+                     metavar="N",
+                     help="live-worker floor: below it the daemon sheds "
+                          "load (503 + Retry-After) instead of queueing "
+                          "into a dead pool")
     psv.add_argument("--job-workers", type=int, default=1,
                      help="threads draining the async job queue "
                           "(/v1/sweep)")
@@ -1300,6 +1346,18 @@ def main(argv: list[str] | None = None) -> int:
                      help="arch for --trace mix entries")
     psb.add_argument("--no-cli-baseline", action="store_true",
                      help="skip the cold-CLI comparison run")
+    psb.add_argument("--serve-workers", type=int, default=0, metavar="N",
+                     help="boot the self-hosted daemon with N supervised "
+                          "worker processes (serve v2; default 0 = "
+                          "single-process)")
+    psb.add_argument("--worker-sweep", default=None, metavar="N,N,...",
+                     help="scaling curve: one warm bench leg per worker "
+                          "count (0 = single-process baseline), e.g. "
+                          "'0,1,2,4'; overrides --url/--serve-workers")
+    psb.add_argument("--reps", type=int, default=3, metavar="N",
+                     help="measured storms per leg; each leg reports its "
+                          "best-throughput pass (noisy-neighbor armor; "
+                          "errors from every pass still count)")
     psb.add_argument("--json", default=None,
                      help="also write the report document here")
     psb.set_defaults(fn=_cmd_serve_bench)

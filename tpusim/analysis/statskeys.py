@@ -69,9 +69,13 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/perf/", "tpusim/sim/driver.py", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
-    # the serving layer (PR 5): daemon request/admission/job counters
-    # exported on /metrics (prometheus gauges, not report lines) —
-    # minted only by tpusim.serve and the CI serve smoke
+    # the serving layer (PR 5, extended by serve v2): daemon request/
+    # admission/job counters plus the supervised worker-pool gauges
+    # (serve_workers_alive, serve_worker_restarts_total,
+    # serve_worker_kills_total, serve_quarantine_size,
+    # serve_shed_503_total, ...) exported on /metrics (prometheus
+    # gauges, not report lines) — minted only by tpusim.serve and the
+    # CI serve smokes
     "serve_": (
         "tpusim/serve/", "ci/check_golden.py",
     ),

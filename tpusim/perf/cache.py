@@ -304,9 +304,19 @@ class ResultCache:
         disk_dir: str | Path | None = None,
         max_entries: int = 1024,
         obs=None,
+        durable: bool = False,
     ):
         self.disk_dir = Path(disk_dir) if disk_dir else None
         self.max_entries = max(int(max_entries), 1)
+        # durable=True fsyncs each record (and its directory entry)
+        # before the atomic publish.  The plain mode is already safe
+        # against torn FILES (temp + os.replace); durability closes the
+        # host-crash window where the rename survives but the data
+        # blocks do not — a short-read record every later reader would
+        # warn about.  The serve v2 worker fleet writes its shared L2
+        # through this, so a worker killed mid-publish (or a node dying
+        # under the pool) never poisons the tier for the survivors.
+        self.durable = bool(durable)
         self.obs = obs if obs is not None else NULL_OBS
         self._mem: OrderedDict[str, EngineResult] = OrderedDict()
         # the serving daemon shares one instance across request threads;
@@ -456,8 +466,20 @@ class ResultCache:
                 tmp = path.with_suffix(
                     f".{os.getpid()}.{threading.get_ident()}.tmp"
                 )
-                tmp.write_text(json.dumps(doc))
+                with open(tmp, "w") as f:
+                    f.write(json.dumps(doc))
+                    if self.durable:
+                        f.flush()
+                        os.fsync(f.fileno())
                 os.replace(tmp, path)  # atomic: readers never see a torn file
+                if self.durable:
+                    # the rename itself must reach disk too, or a crash
+                    # replays the old directory with the new inode gone
+                    dir_fd = os.open(self.disk_dir, os.O_RDONLY)
+                    try:
+                        os.fsync(dir_fd)
+                    finally:
+                        os.close(dir_fd)
             except OSError as e:
                 self.disk_errors += 1
                 self.obs.counter_add("cache.disk_errors")

@@ -37,6 +37,7 @@ import threading
 from typing import Any, Callable, Iterable
 
 __all__ = [
+    "DeferSignals",
     "env_workers",
     "map_ordered",
     "pool_context",
@@ -85,6 +86,12 @@ class _DeferSignals:
             for signum in self._received:
                 os.kill(os.getpid(), signum)
         return False
+
+
+#: public name: the serve v2 supervisor wraps its initial worker fork in
+#: the same discipline (a SIGTERM landing mid-fork defers until the
+#: fleet is registered, so the drain path reaps children, never orphans)
+DeferSignals = _DeferSignals
 
 #: shared per-call inputs for worker functions; in the parent this is set
 #: by :func:`map_ordered` (the serial path uses it too, so workers are

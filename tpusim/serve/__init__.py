@@ -29,6 +29,7 @@ tpusim serve`` starts it; :mod:`.client` is the typed urllib client and
 from tpusim.serve.admission import (
     AdmissionController,
     DeadlineExceeded,
+    Degraded,
     Draining,
     JobTable,
     Overloaded,
@@ -36,11 +37,13 @@ from tpusim.serve.admission import (
 from tpusim.serve.client import ServeClient, ServeError
 from tpusim.serve.daemon import SERVE_FORMAT_VERSION, ServeDaemon
 from tpusim.serve.registry import TraceRegistry
+from tpusim.serve.supervisor import Supervisor, WorkerTimeout
 from tpusim.serve.worker import RequestError, ServeWorker
 
 __all__ = [
     "AdmissionController",
     "DeadlineExceeded",
+    "Degraded",
     "Draining",
     "JobTable",
     "Overloaded",
@@ -50,5 +53,7 @@ __all__ = [
     "ServeDaemon",
     "ServeError",
     "ServeWorker",
+    "Supervisor",
     "TraceRegistry",
+    "WorkerTimeout",
 ]

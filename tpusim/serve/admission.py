@@ -33,6 +33,7 @@ from pathlib import Path
 __all__ = [
     "AdmissionController",
     "DeadlineExceeded",
+    "Degraded",
     "Draining",
     "Job",
     "JobTable",
@@ -56,6 +57,20 @@ class DeadlineExceeded(RuntimeError):
 
 class Draining(RuntimeError):
     """The daemon is shutting down and admits nothing new — the 503."""
+
+
+class Degraded(RuntimeError):
+    """The supervised worker pool has fewer live workers than its floor
+    — the serve v2 load-shedding 503 + ``Retry-After``.  Queueing into a
+    dead pool would convert every request into a slow 504; telling the
+    client to come back when the restart backoff opens is cheaper for
+    both sides."""
+
+    def __init__(self, retry_after_s: float):
+        self.retry_after_s = max(float(retry_after_s), 1.0)
+        super().__init__(
+            f"worker pool degraded; retry after {self.retry_after_s:.0f}s"
+        )
 
 
 class AdmissionController:

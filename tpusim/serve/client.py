@@ -12,13 +12,25 @@ over :mod:`http.client`, reconnecting transparently when the server
 closed it.  A warm request prices in ~1ms server-side; paying a fresh
 TCP handshake + connection teardown per call (urllib's behavior) would
 cost more than the service itself.
+
+Robustness (serve v2): every call carries a socket timeout (the
+constructor default, overridable per call via ``timeout_s=``) so a
+stalled daemon can never block the client forever, and **safe**
+failures — an idempotent GET, or a POST whose bytes never finished
+sending — retry with exponential backoff plus deterministic jitter.  A
+POST that finished sending is never replayed: the server may have
+executed it, and a re-sent ``/v1/sweep`` would enqueue a duplicate job.
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.client
+import itertools
 import json
+import os
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 
@@ -93,12 +105,28 @@ class JobStatus:
         return self.status in ("done", "failed")
 
 
+#: per-process client counter — the instance half of the jitter salt
+_CLIENT_SEQ = itertools.count()
+
+
 class ServeClient:
     """One daemon endpoint; every method is a single HTTP round trip."""
 
-    def __init__(self, base_url: str, timeout_s: float = 120.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout_s: float = 120.0,
+        retries: int = 1,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        #: extra attempts for SAFE failures (idempotent GETs and POSTs
+        #: whose bytes never finished sending); 0 disables retrying
+        self.retries = max(int(retries), 0)
+        self.backoff_base_s = max(float(backoff_base_s), 0.0)
+        self.backoff_max_s = max(float(backoff_max_s), self.backoff_base_s)
         parsed = urllib.parse.urlsplit(self.base_url)
         if parsed.scheme != "http" or parsed.hostname is None:
             raise ValueError(
@@ -107,28 +135,68 @@ class ServeClient:
         self._host = parsed.hostname
         self._port = parsed.port or 80
         self._local = threading.local()
+        # (pid, construction order) — distinct per client instance and
+        # per process, yet stable for a given run's construction order,
+        # so retry timing stays reproducible within one test run
+        self._jitter_salt = f"{os.getpid()}:{next(_CLIENT_SEQ)}"
 
     # -- transport -----------------------------------------------------------
 
-    def _conn(self, fresh: bool = False) -> http.client.HTTPConnection:
+    def _conn(
+        self, fresh: bool = False, timeout_s: float | None = None,
+    ) -> http.client.HTTPConnection:
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
         conn = getattr(self._local, "conn", None)
         if conn is None or fresh:
             if conn is not None:
                 conn.close()
             conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=self.timeout_s,
+                self._host, self._port, timeout=t,
             )
             self._local.conn = conn
+        elif conn.timeout != t:
+            # per-call override on a warm keep-alive connection: the
+            # timeout lives on the live socket, not just the factory
+            conn.timeout = t
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
         return conn
 
-    def _raw(self, method: str, path: str, body: dict | None = None):
+    def _backoff_s(self, attempt: int, path: str) -> float:
+        """Exponential backoff with deterministic jitter (±25%, derived
+        from the client instance + call identity: N identical clients
+        retrying the same failed path each land on a DIFFERENT sleep —
+        the herd de-synchronizes — while any one client's schedule is
+        stable within a run)."""
+        base = min(
+            self.backoff_base_s * (2.0 ** attempt), self.backoff_max_s,
+        )
+        h = hashlib.sha256(
+            f"{self._jitter_salt}:{path}:{attempt}".encode()
+        ).digest()
+        return base * (0.75 + 0.5 * int.from_bytes(h[:4], "big") / 0xFFFFFFFF)
+
+    def _raw(
+        self, method: str, path: str, body: dict | None = None,
+        timeout_s: float | None = None,
+    ):
         data = None
         headers = {"Accept": "application/json"}
         if body is not None:
             data = json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
-        for attempt in (0, 1):
-            conn = self._conn(fresh=attempt > 0)
+        attempt = 0
+        fresh = False
+        # a REUSED keep-alive socket the server closed between calls is
+        # a transport artifact, not a failing server: safe requests get
+        # ONE immediate reconnect that neither counts against the retry
+        # policy (retries=0 must still survive idle-closed connections,
+        # as PR 5's client always did) nor sleeps (a backoff here would
+        # tax every request after any idle gap)
+        stale_budget = 1
+        while True:
+            was_cached = getattr(self._local, "conn", None) is not None
+            conn = self._conn(fresh=fresh, timeout_s=timeout_s)
             sent = False
             try:
                 conn.request(method, path, body=data, headers=headers)
@@ -137,27 +205,41 @@ class ServeClient:
                 payload = resp.read()
                 return resp, payload
             except (http.client.HTTPException, ConnectionError,
-                    BrokenPipeError) as e:
+                    BrokenPipeError, TimeoutError) as e:
                 # the server may close an idle keep-alive connection
-                # between calls; one reconnect covers that, a second
-                # failure is real.  A non-idempotent request that
-                # FINISHED SENDING is never replayed — the server may
-                # have executed it (a re-sent /v1/sweep would enqueue a
+                # between calls, a daemon may be mid-restart, a stalled
+                # one times out.  A non-idempotent request that FINISHED
+                # SENDING is never replayed — the server may have
+                # executed it (a re-sent /v1/sweep would enqueue a
                 # second job) — so only send-stage failures and safe
-                # methods retry.
+                # methods retry, with jittered backoff between attempts.
                 conn.close()
                 self._local.conn = None
+                fresh = True
                 retryable = method == "GET" or not sent
-                if attempt or not retryable:
+                if (
+                    retryable and was_cached and stale_budget > 0
+                    and not isinstance(e, TimeoutError)
+                ):
+                    # a timeout is a real wait, never the stale case
+                    stale_budget -= 1
+                    continue
+                if attempt >= self.retries or not retryable:
+                    code = (
+                        "timeout" if isinstance(e, TimeoutError)
+                        else "connection_failed"
+                    )
                     raise ServeError(
-                        0, "connection_failed",
-                        f"{type(e).__name__}: {e}",
+                        0, code, f"{type(e).__name__}: {e}",
                     ) from None
+                time.sleep(self._backoff_s(attempt, path))
+                attempt += 1
 
     def _request(
         self, method: str, path: str, body: dict | None = None,
+        timeout_s: float | None = None,
     ) -> dict:
-        resp, payload = self._raw(method, path, body)
+        resp, payload = self._raw(method, path, body, timeout_s=timeout_s)
         try:
             doc = json.loads(payload or b"{}")
         except (json.JSONDecodeError, ValueError):
@@ -175,17 +257,20 @@ class ServeClient:
 
     # -- routes --------------------------------------------------------------
 
-    def healthz(self) -> dict:
-        return self._request("GET", "/healthz")
+    def healthz(self, timeout_s: float | None = None) -> dict:
+        return self._request("GET", "/healthz", timeout_s=timeout_s)
 
-    def metrics_text(self) -> str:
-        resp, payload = self._raw("GET", "/metrics")
+    def metrics_text(self, timeout_s: float | None = None) -> str:
+        resp, payload = self._raw("GET", "/metrics", timeout_s=timeout_s)
         if resp.status != 200:
             raise ServeError(resp.status, "http_error", resp.reason)
         return payload.decode()
 
-    def traces(self) -> list[str]:
-        return list(self._request("GET", "/v1/traces").get("traces", []))
+    def traces(self, timeout_s: float | None = None) -> list[str]:
+        return list(
+            self._request("GET", "/v1/traces", timeout_s=timeout_s)
+            .get("traces", [])
+        )
 
     def simulate(
         self,
@@ -198,6 +283,7 @@ class ServeClient:
         num_devices: int = 1,
         validate: bool = True,
         deadline_ms: int | None = None,
+        timeout_s: float | None = None,
     ) -> SimResult:
         body: dict = {"tuned": tuned, "validate": validate}
         if trace is not None:
@@ -213,7 +299,9 @@ class ServeClient:
             body["faults"] = faults
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
-        doc = self._request("POST", "/v1/simulate", body)
+        doc = self._request(
+            "POST", "/v1/simulate", body, timeout_s=timeout_s,
+        )
         return SimResult(
             stats=doc["stats"],
             cache_hit=bool(doc["cache_hit"]),
@@ -233,6 +321,7 @@ class ServeClient:
         overlays: list[dict] | None = None,
         faults: dict | None = None,
         num_devices: int = 1,
+        timeout_s: float | None = None,
     ) -> LintReport:
         body: dict = {}
         if trace is not None:
@@ -246,7 +335,7 @@ class ServeClient:
             body["overlays"] = overlays
         if faults is not None:
             body["faults"] = faults
-        doc = self._request("POST", "/v1/lint", body)
+        doc = self._request("POST", "/v1/lint", body, timeout_s=timeout_s)
         return LintReport(
             summary=str(doc["summary"]),
             errors=int(doc["errors"]),
@@ -255,29 +344,37 @@ class ServeClient:
             model_version=str(doc["model_version"]),
         )
 
-    def sweep(self, **request) -> str:
+    def sweep(self, timeout_s: float | None = None, **request) -> str:
         """Submit an async sweep; returns the job id."""
-        doc = self._request("POST", "/v1/sweep", request)
+        doc = self._request(
+            "POST", "/v1/sweep", request, timeout_s=timeout_s,
+        )
         return str(doc["job_id"])
 
-    def campaign(self, **request) -> str:
+    def campaign(self, timeout_s: float | None = None, **request) -> str:
         """Submit an async Monte-Carlo campaign (``spec=`` + the usual
         ``trace=``/``hlo_text=``); returns the job id.  Poll with
         :meth:`wait_job` — the result is the campaign report
         document."""
-        doc = self._request("POST", "/v1/campaign", request)
+        doc = self._request(
+            "POST", "/v1/campaign", request, timeout_s=timeout_s,
+        )
         return str(doc["job_id"])
 
-    def advise(self, **request) -> str:
+    def advise(self, timeout_s: float | None = None, **request) -> str:
         """Submit an async sharding-advisor sweep (``spec=`` + the
         usual ``trace=``/``hlo_text=``); returns the job id.  Poll
         with :meth:`wait_job` — the result is the ranked advise
         report document."""
-        doc = self._request("POST", "/v1/advise", request)
+        doc = self._request(
+            "POST", "/v1/advise", request, timeout_s=timeout_s,
+        )
         return str(doc["job_id"])
 
-    def job(self, job_id: str) -> JobStatus:
-        doc = self._request("GET", f"/v1/jobs/{job_id}")
+    def job(self, job_id: str, timeout_s: float | None = None) -> JobStatus:
+        doc = self._request(
+            "GET", f"/v1/jobs/{job_id}", timeout_s=timeout_s,
+        )
         return JobStatus(
             job_id=str(doc["job_id"]),
             status=str(doc["status"]),
@@ -288,14 +385,14 @@ class ServeClient:
 
     def wait_job(
         self, job_id: str, timeout_s: float = 120.0,
-        poll_s: float = 0.1,
+        poll_s: float = 0.1, poll_timeout_s: float | None = None,
     ) -> JobStatus:
-        """Poll until the job is terminal; raises TimeoutError."""
-        import time
-
+        """Poll until the job is terminal; raises TimeoutError.
+        ``timeout_s`` bounds the whole wait; ``poll_timeout_s`` is the
+        per-poll socket timeout (the constructor default otherwise)."""
         deadline = time.monotonic() + timeout_s
         while True:
-            status = self.job(job_id)
+            status = self.job(job_id, timeout_s=poll_timeout_s)
             if status.terminal:
                 return status
             if time.monotonic() >= deadline:

@@ -48,11 +48,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tpusim.serve.admission import (
     AdmissionController,
     DeadlineExceeded,
+    Degraded,
     Draining,
     JobTable,
     Overloaded,
 )
 from tpusim.serve.registry import TraceRegistry
+from tpusim.serve.supervisor import Supervisor, WorkerTimeout
 from tpusim.serve.worker import MAX_DEADLINE_S, RequestError, ServeWorker
 
 __all__ = ["SERVE_FORMAT_VERSION", "ServeDaemon"]
@@ -108,6 +110,19 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away; the work is done either way
         d._count_status(status)
+
+    def _send_body(self, status: int, body: bytes) -> None:
+        """Pre-serialized JSON body (a supervised worker's ok_bytes
+        response — already carries the format/model_version envelope)."""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        self.daemon_obj._count_status(status)
 
     def _send_text(self, status: int, text: str, content_type: str) -> None:
         body = text.encode()
@@ -172,12 +187,24 @@ class _Handler(BaseHTTPRequestHandler):
             if d.admission.draining:
                 self._send_json(503, {"status": "draining"})
             else:
-                self._send_json(200, {
+                doc = {
                     "status": "ok",
                     "uptime_s": round(time.monotonic() - d._clock0, 3),
                     **{f"admission_{k}": v
                        for k, v in d.admission.stats_dict().items()},
-                })
+                }
+                sup = d.supervisor
+                if sup is not None:
+                    alive = sup.alive_count()
+                    # degraded is a STATE, not an outage: the daemon
+                    # still answers (shedding), so /healthz stays 200
+                    # and balancers read the field, not the status code
+                    if alive < sup.min_live:
+                        doc["status"] = "degraded"
+                    doc["workers_alive"] = alive
+                    doc["workers_configured"] = sup.num_workers
+                    doc["workers"] = sup.worker_docs()
+                self._send_json(200, doc)
         elif path == "/metrics":
             d._count("serve_requests_metrics_total")
             self._send_text(200, d.metrics_text(), "text/plain; version=0.0.4")
@@ -262,7 +289,7 @@ class _Handler(BaseHTTPRequestHandler):
                     d.work_hook(endpoint, body)
                 if time.monotonic() >= deadline:
                     raise DeadlineExceeded("deadline expired at admission")
-                result = fn(body)
+                result = d.execute_sync(endpoint, fn, body, deadline)
         except RequestError as e:
             if e.status == 400:
                 d._count("serve_validation_400_total")
@@ -279,6 +306,33 @@ class _Handler(BaseHTTPRequestHandler):
                     f"queue is full; retry later"
                 ),
             }, headers={"Retry-After": int(e.retry_after_s)})
+            return
+        except Degraded as e:
+            # serve v2 load shedding: the worker pool is below its live
+            # floor, so queueing would only convert this request into a
+            # slow 504 — tell the client when the restart backoff opens.
+            # serve_shed_503_total is minted by the supervisor alone
+            # (its stats_dict merges last into /metrics); counting here
+            # too would shadow-write a value the merge then overwrites.
+            self._send_json(503, {
+                "error": "degraded",
+                "detail": (
+                    "worker pool is below its live floor; retry after "
+                    "the restart backoff"
+                ),
+            }, headers={"Retry-After": int(e.retry_after_s)})
+            return
+        except WorkerTimeout:
+            # ordered before DeadlineExceeded (its parent): the request
+            # STARTED and its worker was killed for outliving the budget
+            d._count("serve_deadline_504_total")
+            self._send_json(504, {
+                "error": "deadline_exceeded",
+                "detail": (
+                    f"pricing exceeded the {budget_s:.3f}s deadline; "
+                    f"the worker was killed and is being restarted"
+                ),
+            })
             return
         except DeadlineExceeded:
             d._count("serve_deadline_504_total")
@@ -304,7 +358,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "detail": f"{type(e).__name__}: {e}",
             })
             return
-        self._send_json(200, result)
+        if isinstance(result, (bytes, bytearray)):
+            self._send_body(200, bytes(result))
+        else:
+            self._send_json(200, result)
 
 
 class ServeDaemon:
@@ -322,6 +379,10 @@ class ServeDaemon:
         result_cache=None,
         cache_entries: int = 4096,
         workers: int = 1,
+        serve_workers: int = 0,
+        min_workers: int = 1,
+        restart_backoff_s: float = 0.05,
+        chaos_hooks: bool = False,
         job_workers: int = 1,
         job_queue_depth: int = 16,
         drain_grace_s: float = 60.0,
@@ -354,6 +415,40 @@ class ServeDaemon:
         self.worker = ServeWorker(
             self.registry, result_cache=self.result_cache, workers=workers,
         )
+        # serve v2: serve_workers >= 1 mounts the supervised pre-forked
+        # worker pool — sync pricing (simulate/lint) moves into N
+        # crash-isolated processes behind the admission layer, each with
+        # its own registry + L1 cache and the daemon's disk cache dir
+        # (when mounted) as the shared durable L2.  0 keeps the PR 5
+        # single-process path, byte-identical by contract.
+        self.serve_workers = max(int(serve_workers), 0)
+        self.supervisor: Supervisor | None = None
+        if self.serve_workers > 0:
+            self.supervisor = Supervisor(
+                settings={
+                    "trace_root": str(trace_root) if trace_root else None,
+                    "disk_cache_dir": (
+                        str(self.result_cache.disk_dir)
+                        if self.result_cache.disk_dir else None
+                    ),
+                    "cache_entries": int(cache_entries),
+                    "chaos_hooks": bool(chaos_hooks),
+                    # lets workers serialize the FINAL response body
+                    # (byte-identical to _send_json's by construction)
+                    "format_version": SERVE_FORMAT_VERSION,
+                },
+                num_workers=self.serve_workers,
+                min_live=min_workers,
+                restart_backoff_s=restart_backoff_s,
+            )
+            if self.result_cache.disk_dir is not None:
+                # the parent still publishes to the shared dir (async
+                # sweep/campaign/advise jobs price in parent threads);
+                # its writes must carry the same fsync-before-replace
+                # guarantee the workers' durable L2 does, or a host
+                # crash mid-parent-publish leaves the short-read record
+                # the durable tier exists to rule out
+                self.result_cache.durable = True
         self.admission = AdmissionController(
             max_inflight=max_inflight, queue_depth=queue_depth,
         )
@@ -418,6 +513,9 @@ class ServeDaemon:
             values[f"serve_registry_{k}"] = v
         for k, v in self.worker.stats_dict().items():
             values[f"serve_{k}"] = v
+        if self.supervisor is not None:
+            for k, v in self.supervisor.stats_dict().items():
+                values[f"serve_{k}"] = v
         return prometheus_text(
             values,
             help_text={
@@ -425,6 +523,18 @@ class ServeDaemon:
                 "serve_uptime_s": "seconds since daemon start",
             },
         )
+
+    # -- sync dispatch -------------------------------------------------------
+
+    def execute_sync(self, endpoint: str, fn, body: dict, deadline: float):
+        """One admitted synchronous request: through the supervised
+        worker pool when mounted (crash isolation, deadline kills,
+        quarantine — the serve v2 path), else the in-process worker
+        (``fn``), which is the PR 5 single-process contract.  Responses
+        are byte-identical either way."""
+        if self.supervisor is not None:
+            return self.supervisor.execute(endpoint, body, deadline=deadline)
+        return fn(body)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -456,6 +566,14 @@ class ServeDaemon:
             (self.host, self._requested_port), handler,
         )
         self._httpd.daemon_threads = True
+        if self.supervisor is not None:
+            # forked workers inherit the freshly-bound listener; they
+            # close it first thing (the fd travels via settings) so a
+            # dead daemon's port is never held open by its workers
+            self.supervisor.settings["inherited_fds"] = [
+                self._httpd.fileno()
+            ]
+            self.supervisor.start()
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
             name="tpusim-serve-accept", daemon=True,
@@ -526,6 +644,8 @@ class ServeDaemon:
         self._stop_jobs.set()
         for t in self._job_threads:
             t.join(timeout=2.0)
+        if self.supervisor is not None:
+            self.supervisor.stop()
         flushed = self.result_cache.flush()
         if self.verbose and flushed:
             print(f"tpusim serve: drain flushed {flushed} cache records")
@@ -543,6 +663,10 @@ class ServeDaemon:
         self._stop_jobs.set()
         for t in self._job_threads:
             t.join(timeout=2.0)
+        if self.supervisor is not None:
+            # crash simulation still reaps the fleet: orphan workers
+            # would hold the (inherited) state the next daemon needs
+            self.supervisor.stop(grace_s=0.2)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -59,6 +59,11 @@ class TraceRegistry:
         self._entries: dict[str, RegistryEntry] = {}
         self._inline: dict[str, RegistryEntry] = {}
         self._lock = threading.Lock()
+        # single-flight gate per trace name: a thundering herd on a
+        # cold daemon (serve v2 boots N workers that all field their
+        # first request at once) parses each trace ONCE per process,
+        # not once per thread — parse is seconds for large pods
+        self._loading: dict[str, threading.Lock] = {}
 
     # -- named traces --------------------------------------------------------
 
@@ -99,13 +104,21 @@ class TraceRegistry:
             )
         from tpusim.trace.format import load_trace
 
-        pod = load_trace(path)
+        # single-flight: the first thread to reach a cold name parses
+        # it; racers block on the per-name gate and then read the hot
+        # entry instead of re-parsing the same pod concurrently
         with self._lock:
-            # two threads racing the first load both parse; the first
-            # insert wins so every later request shares one pod
-            entry = self._entries.setdefault(
-                name, RegistryEntry(name=name, pod=pod)
-            )
+            gate = self._loading.setdefault(name, threading.Lock())
+        with gate:
+            with self._lock:
+                entry = self._entries.get(name)
+            if entry is None:
+                pod = load_trace(path)
+                with self._lock:
+                    entry = self._entries.setdefault(
+                        name, RegistryEntry(name=name, pod=pod)
+                    )
+                    self._loading.pop(name, None)
         return entry
 
     def trace_diagnostics(self, entry: RegistryEntry):

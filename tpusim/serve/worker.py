@@ -40,7 +40,7 @@ import threading
 from tpusim.perf.cache import ResultCache
 from tpusim.timing.model_version import model_version
 
-__all__ = ["RequestError", "ServeWorker"]
+__all__ = ["RequestError", "ServeWorker", "worker_child_main"]
 
 #: hard cap on request deadlines — a client cannot pin a slot forever
 MAX_DEADLINE_S = 600.0
@@ -470,3 +470,139 @@ class ServeWorker:
         with self._job_lock:
             out.update(self._job_totals)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Supervised worker process (serve v2)
+# ---------------------------------------------------------------------------
+
+#: endpoints a supervised worker will execute; everything else is a
+#: supervisor-side programming error, not client-reachable state
+_CHILD_ENDPOINTS = frozenset({"simulate", "lint"})
+
+
+def worker_child_main(index: int, conn, settings: dict) -> None:
+    """Entry point of one supervised worker process.
+
+    ``conn`` is the child end of the supervisor's duplex pipe; the
+    protocol is ``(req_id, endpoint, body)`` in, ``(req_id, kind,
+    payload)`` out with ``kind`` one of ``ack`` / ``ok`` /
+    ``request_error`` / ``error``, and ``None`` as the shutdown
+    sentinel.  The ``ack`` frame goes back the instant a request is
+    read off the pipe, BEFORE any work: a worker that dies without
+    acking provably never started the request, so the supervisor can
+    retry it without charging the poison budget — a send() that landed
+    in the pipe buffer of a worker the OOM killer then took is not the
+    request's fault.  The response
+    payloads are the exact objects the in-process :class:`ServeWorker`
+    returns/raises — the supervisor re-raises them in the parent, which
+    is what keeps multi-worker responses byte-identical to the
+    single-process daemon.
+
+    Each worker owns its whole pricing world: a private
+    :class:`~tpusim.serve.registry.TraceRegistry` (per-worker hot pods —
+    affinity dispatch keeps a trace parsed in ~one worker), a private
+    in-memory L1 :class:`~tpusim.perf.ResultCache`, and, when
+    ``settings["disk_cache_dir"]`` is set, the shared disk tier as L2
+    with ``durable=True`` (fsync-before-replace: a worker killed
+    mid-publish can never leave a short-read record for the fleet to
+    warn about).  Nothing here is shared mutable state with the parent,
+    so a SIGKILL at any instant costs exactly this process.
+
+    ``settings["chaos_hooks"]`` arms the fault-injection hooks the chaos
+    tests and the CI chaos smoke use (``_chaos_exit`` → ``os._exit``,
+    ``_chaos_sleep_s`` → sleep before pricing); a production daemon
+    never sets it.
+    """
+    import os
+    import signal as _signal
+    import time as _time
+
+    # the parent's handlers (SIGTERM → drain) are wrong here: a worker
+    # dies promptly on TERM (the supervisor escalates to KILL anyway)
+    # and ignores INT (a terminal ^C must drain via the parent, which
+    # reaps the fleet — not race it to death)
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+    _signal.signal(_signal.SIGINT, _signal.SIG_IGN)
+    # under fork the child inherits the daemon's listening socket; keep
+    # it and a killed daemon's port stays bound by its orphans
+    for fd in settings.get("inherited_fds") or ():
+        try:
+            os.close(int(fd))
+        except (OSError, ValueError, TypeError):
+            pass
+
+    from tpusim.perf.cache import ResultCache
+    from tpusim.serve.registry import TraceRegistry
+
+    disk_dir = settings.get("disk_cache_dir") or None
+    registry = TraceRegistry(settings.get("trace_root"))
+    cache = ResultCache(
+        disk_dir=disk_dir,
+        max_entries=int(settings.get("cache_entries", 4096) or 4096),
+        durable=disk_dir is not None,
+    )
+    worker = ServeWorker(registry, result_cache=cache, workers=1)
+    chaos = bool(settings.get("chaos_hooks"))
+    # the daemon's response format version: when present, success
+    # responses travel as the final serialized body bytes (see below)
+    format_version = settings.get("format_version")
+
+    try:
+        conn.send(("ready", os.getpid()))
+    except (BrokenPipeError, OSError):
+        return
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        if msg is None:
+            return
+        req_id, endpoint, body = msg
+        try:
+            # ack before ANY work (chaos hooks included): death after
+            # this frame means the request was in flight when the
+            # worker died — the supervisor's poison accounting keys
+            # off exactly that distinction
+            conn.send((req_id, "ack", None))
+        except (BrokenPipeError, OSError):
+            return
+        if chaos and isinstance(body, dict):
+            if body.get("_chaos_exit"):
+                os._exit(3)
+            nap = body.get("_chaos_sleep_s")
+            if nap:
+                _time.sleep(min(float(nap), 30.0))
+        try:
+            if endpoint not in _CHILD_ENDPOINTS:
+                raise RequestError(
+                    404, "unknown_endpoint",
+                    f"supervised workers serve {sorted(_CHILD_ENDPOINTS)},"
+                    f" not {endpoint!r}",
+                )
+            result = getattr(worker, endpoint)(body)
+        except RequestError as e:
+            out = (req_id, "request_error",
+                   (e.status, e.code, e.detail, e.extra))
+        except Exception as e:  # noqa: BLE001 - the worker's 500 boundary
+            out = (req_id, "error", f"{type(e).__name__}: {e}")
+        else:
+            if format_version is not None:
+                # serialize HERE, byte-for-byte what the parent's
+                # _send_json would produce (same dumps args, same
+                # envelope): the parent then writes the bytes straight
+                # to the socket instead of unpickling a ~10 KB stats
+                # dict and re-serializing it under its GIL — the hot
+                # half of the per-request parent cost
+                out = (req_id, "ok_bytes", json.dumps({
+                    "format_version": format_version,
+                    "model_version": worker.model_version,
+                    **result,
+                }, sort_keys=True).encode() + b"\n")
+            else:
+                out = (req_id, "ok", result)
+        try:
+            conn.send(out)
+        except (BrokenPipeError, OSError):
+            return
