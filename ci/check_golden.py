@@ -316,10 +316,10 @@ def lint_smoke() -> dict:
     return {"artifacts": checked, "codes": len(CODES)}
 
 
-#: stats the perf layer adds only when active — stripped before golden
-#: comparison (the determinism contract covers the simulation stats,
-#: not the layer's own accounting)
-PERF_KEY_PREFIXES = ("cache_", "pool_")
+#: stats the perf/guard layers add only when active — stripped before
+#: golden comparison (the determinism contract covers the simulation
+#: stats, not the layers' own accounting)
+PERF_KEY_PREFIXES = ("cache_", "pool_", "guard_")
 
 
 def perf_smoke() -> dict:
@@ -978,6 +978,155 @@ def fastpath_smoke() -> dict:
     }
 
 
+#: --guard-smoke store quota: above the largest single matrix record
+#: (~52KB) so GC never deletes the record just published, below the
+#: matrix total (~159KB) so the quota provably engages
+GUARD_SMOKE_QUOTA_BYTES = 64 * 1024
+
+
+def guard_smoke(serve_workers: int = 2) -> dict:
+    """Resource-governance contract (tpusim.guard):
+
+    1. the golden matrix priced under a deliberately small
+       ``--cache-quota`` must stay byte-identical to the committed
+       goldens (quota/GC change WHETHER records persist, never the
+       arithmetic — ``guard_*``/``cache_*`` accounting keys stripped
+       like every perf-layer smoke), the store must sit at or under the
+       quota after every run, and the GC must have actually engaged;
+    2. a served request that outlives its deadline must 504 through
+       cooperative IN-PROCESS cancellation: the worker that priced it
+       survives (zero restarts, zero kills, same pids), answers the
+       next request from its warm caches, and the coop-cancel counter
+       lands on /metrics.
+    Raises on violation."""
+    import tempfile
+
+    from tpusim.guard.store import store_bytes
+    from tpusim.perf.cache import ResultCache
+    from tpusim.sim.driver import simulate_trace
+
+    quota = GUARD_SMOKE_QUOTA_BYTES
+    with tempfile.TemporaryDirectory(prefix="tpusim_guard_smoke_") as td:
+        cache_dir = Path(td) / "cache"
+        cache = ResultCache(disk_dir=cache_dir, quota_bytes=quota)
+        got = {}
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            report = simulate_trace(
+                FIXTURES / fixture, arch=arch, overlays=list(overlays),
+                tuned=False, result_cache=cache,
+            )
+            got[name] = {
+                k: v for k, v in json.loads(report.stats.to_json()).items()
+                if k not in VOLATILE
+                and not k.startswith(PERF_KEY_PREFIXES)
+            }
+            on_disk = store_bytes(cache_dir)
+            if on_disk > quota:
+                raise ValueError(
+                    f"guard smoke: store at {on_disk} bytes after "
+                    f"{name}, over the {quota}-byte quota"
+                )
+        errors = compare(got)
+        if errors:
+            raise ValueError(
+                "quota-governed matrix diverged from committed "
+                "goldens:\n  " + "\n  ".join(errors)
+            )
+        if cache.gc_runs == 0:
+            raise ValueError(
+                "guard smoke: the quota never engaged (zero GC runs) — "
+                "the matrix shrank or the quota grew; retune "
+                "GUARD_SMOKE_QUOTA_BYTES so the bound is actually "
+                "exercised"
+            )
+        gc_runs, gc_deleted = cache.gc_runs, cache.gc_deleted
+
+    # -- part 2: cooperative deadline cancel through the worker pool ----
+    from tpusim.serve.client import ServeClient, ServeError
+    from tpusim.serve.daemon import ServeDaemon
+
+    daemon = ServeDaemon(
+        trace_root=FIXTURES, serve_workers=serve_workers,
+        chaos_hooks=True,
+    ).start()
+    try:
+        client = ServeClient(daemon.url)
+        warmup = client.simulate(trace="matmul_512", arch="v5e",
+                                 tuned=False)
+        pids_before = [
+            w["pid"] for w in client.healthz()["workers"]
+        ]
+        # a cancel-aware stand-in for slow pricing (the chaos spin hook
+        # checks its CancelToken at pricing grain), far past a 400ms
+        # deadline: the worker must cancel in-process, never be killed
+        try:
+            r, _ = client._raw("POST", "/v1/simulate", {
+                "trace": "matmul_512", "arch": "v5e", "tuned": False,
+                "_chaos_spin_s": 10, "deadline_ms": 400,
+            })
+            payload = json.loads(_)
+            status = r.status
+        except ServeError as e:  # pragma: no cover - transport failure
+            raise ValueError(f"guard smoke: coop-cancel request died "
+                             f"in transport: {e}")
+        if status != 504 or "cooperative" not in str(
+            payload.get("detail", "")
+        ):
+            raise ValueError(
+                f"guard smoke: expected an in-process-cancel 504, got "
+                f"{status} {payload.get('detail')!r}"
+            )
+        health = client.healthz()
+        pids_after = [w["pid"] for w in health["workers"]]
+        restarts = sum(w["restarts"] for w in health["workers"])
+        kills = sum(w["kills"] for w in health["workers"])
+        if (
+            health["workers_alive"] != serve_workers
+            or restarts != 0 or kills != 0
+            or pids_after != pids_before
+        ):
+            raise ValueError(
+                f"guard smoke: the cooperative cancel cost a worker "
+                f"(alive={health['workers_alive']}, restarts={restarts},"
+                f" kills={kills}, pids {pids_before}->{pids_after})"
+            )
+        prom = client.metrics_text()
+        if "tpusim_serve_worker_coop_cancels_total 1" not in prom:
+            raise ValueError(
+                "guard smoke: /metrics is missing the coop-cancel "
+                "counter"
+            )
+        # the surviving worker's caches are warm: the repeat request is
+        # a cache hit priced by the same pid that was just cancelled
+        repeat = client.simulate(trace="matmul_512", arch="v5e",
+                                 tuned=False)
+        if not repeat.cache_hit:
+            raise ValueError(
+                "guard smoke: post-cancel repeat was not a cache hit — "
+                "the worker's warm state did not survive"
+            )
+        if _serve_served_bytes(repeat.stats) != _serve_served_bytes(
+            warmup.stats
+        ):
+            raise ValueError(
+                "guard smoke: post-cancel repeat stats diverged"
+            )
+    finally:
+        if not daemon.drain_and_stop():
+            raise ValueError("guard smoke: daemon did not drain cleanly")
+    return {
+        "configs": len(got),
+        "quota_bytes": quota,
+        "gc_runs": gc_runs,
+        "gc_deleted": gc_deleted,
+        "serve_workers": serve_workers,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -1031,6 +1180,14 @@ def main(argv: list[str] | None = None) -> int:
                          "a file-backed streaming leg: all docs must "
                          "be byte-identical and match the committed "
                          "goldens")
+    ap.add_argument("--guard-smoke", action="store_true",
+                    help="resource-governance contract: the golden "
+                         "matrix under a small --cache-quota must stay "
+                         "byte-identical while the cache dir never "
+                         "exceeds the quota (GC provably engaged), and "
+                         "a served request past its deadline must 504 "
+                         "via cooperative in-process cancel with zero "
+                         "worker restarts")
     ap.add_argument("--campaign-smoke", action="store_true",
                     help="run the fixed-seed 16-scenario Monte-Carlo "
                          "campaign on the llama_tiny fixture: the "
@@ -1065,6 +1222,23 @@ def main(argv: list[str] | None = None) -> int:
               f"recommendation {summary['recommendation']!r}, warm "
               f"pass zero engine walks, healthy matrix unchanged "
               f"across {summary['matrix_configs']} configs)")
+        return 0
+
+    if args.guard_smoke:
+        try:
+            summary = guard_smoke(
+                serve_workers=max(args.serve_workers, 1)
+            )
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --guard-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --guard-smoke: OK "
+              f"({summary['configs']} configs byte-identical under a "
+              f"{summary['quota_bytes']}-byte quota, "
+              f"{summary['gc_runs']} GC run(s) deleting "
+              f"{summary['gc_deleted']} record(s), store never over "
+              f"quota; deadline 504 via in-process cancel with zero "
+              f"restarts across {summary['serve_workers']} workers)")
         return 0
 
     if args.campaign_smoke:

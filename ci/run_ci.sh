@@ -61,15 +61,22 @@
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  14. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  14. guard   — resource-governance contract (tpusim.guard): the
+#                golden matrix under a small --cache-quota stays
+#                byte-identical while the cache dir never exceeds the
+#                quota (LRU GC provably engaged), and a served request
+#                past its deadline 504s through cooperative in-process
+#                cancellation with the worker still alive (zero
+#                restarts/kills, warm caches serving the next request)
+#  15. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-13
+# Usage:  bash ci/run_ci.sh            # tiers 1-14
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/14] build native from source (+ native parity suite) ==="
+echo "=== [1/15] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -83,47 +90,50 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/14] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/15] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/14] unit tests (fast tier) ==="
+echo "=== [3/15] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/14] golden-stat regression sims ==="
+echo "=== [4/15] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/14] obs export smoke (schema-checked) ==="
+echo "=== [5/15] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/14] faults smoke (degraded-pod contract) ==="
+echo "=== [6/15] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/14] trace/config/schedule lint smoke ==="
+echo "=== [7/15] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/14] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/15] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/14] fastpath parity (pricing-backend byte-identity) ==="
+echo "=== [9/15] fastpath parity (pricing-backend byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/14] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/15] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/14] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/15] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/14] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [12/15] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [13/14] advise smoke (sharding-advisor determinism) ==="
+echo "=== [13/15] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
+echo "=== [14/15] guard smoke (quota/GC + cooperative-cancel contract) ==="
+python ci/check_golden.py --guard-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [14/14] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [15/15] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [14/14] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [15/15] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

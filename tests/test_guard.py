@@ -1,0 +1,672 @@
+"""tpusim.guard — bounded stores, memory governance, cooperative cancel.
+
+The layer's three contracts, pinned:
+
+* **bounded durable stores** — the quota GC deletes whole LRU records
+  only, survives any number of concurrent writers (the multi-process
+  chaos test races a daemon-shaped writer against forked peers under a
+  tight quota and requires ZERO torn reads), and the integrity sweep
+  quarantines damage once instead of warning forever;
+* **memory watchdog** — the degradation ladder runs in its documented
+  order (shrink LRUs → drop compiled tier → force lean streaming) and
+  the terminal shed state clears when pressure does;
+* **cooperative cancellation** — a tripped token unwinds the serial
+  walk, the fastpath, the driver, and the campaign executor at their
+  documented grains; an armed-but-untripped token leaves every byte of
+  the result unchanged; a cancelled campaign resumes to a report
+  byte-identical to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import time
+import warnings
+from pathlib import Path
+
+import pytest
+
+from tpusim.guard.cancel import CancelToken, OperationCancelled
+from tpusim.guard.store import (
+    QUARANTINE_DIR,
+    clear_store,
+    format_size,
+    gc_store,
+    parse_size,
+    scan_store,
+    store_bytes,
+    verify_store,
+)
+from tpusim.guard.watchdog import MemoryWatchdog, default_ladder, rss_bytes
+from tpusim.perf.cache import (
+    CACHE_FORMAT_VERSION,
+    ResultCache,
+    clear_compiled_cache,
+    set_compiled_cache_max,
+)
+from tpusim.timing.config import load_config
+from tpusim.timing.engine import Engine, EngineResult
+from tpusim.trace.format import load_trace
+
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+
+_VOLATILE = ("simulation_rate_kops", "silicon_slowdown", "wall_seconds")
+
+
+def _stats(report) -> dict:
+    # fastpath_* compile counters are process-global cumulative (they
+    # ride only explicitly-backended runs) — outside the per-run
+    # byte-identity claim, like the volatile wall-clock stats
+    return {
+        k: v for k, v in json.loads(report.stats.to_json()).items()
+        if k not in _VOLATILE and not k.startswith("fastpath_")
+    }
+
+
+# -- sizes ------------------------------------------------------------------
+
+def test_parse_size_units_and_refusals():
+    assert parse_size(None) is None
+    assert parse_size(4096) == 4096
+    assert parse_size("65536") == 65536
+    assert parse_size("64K") == 64 * 1024
+    assert parse_size("512M") == 512 << 20
+    assert parse_size("2G") == 2 << 30
+    assert parse_size("1.5g") == int(1.5 * (1 << 30))
+    assert parse_size("2GB") == 2 << 30
+    for bad in ("zero", "-4K", "0", ""):
+        with pytest.raises(ValueError):
+            parse_size(bad)
+
+
+def test_format_size_round_trip_readable():
+    assert format_size(512) == "512B"
+    assert format_size(64 * 1024) == "64.0KiB"
+    assert format_size(3 * (1 << 30)) == "3.0GiB"
+
+
+# -- the store: GC / verify / clear -----------------------------------------
+
+def _write_record(d: Path, name: str, nbytes: int, mtime: float) -> Path:
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / f"{name}.json"
+    doc = {
+        "format_version": CACHE_FORMAT_VERSION,
+        "model_version": "m", "key": name,
+        "result": {"pad": "x" * max(nbytes - 120, 0)},
+    }
+    p.write_text(json.dumps(doc))
+    os.utime(p, (mtime, mtime))
+    return p
+
+
+def test_gc_store_deletes_lru_whole_records_to_quota(tmp_path):
+    now = time.time()
+    # oldest-mtime first is LRU order; each record ~1KB
+    for i in range(8):
+        _write_record(tmp_path, f"r{i}", 1024, now - 100 + i)
+    total = store_bytes(tmp_path)
+    res = gc_store(tmp_path, quota_bytes=total // 2)
+    assert store_bytes(tmp_path) <= total // 2
+    # the oldest records went, the newest survived intact
+    assert not (tmp_path / "r0.json").exists()
+    assert (tmp_path / "r7.json").exists()
+    assert res.deleted >= 4 and res.freed_bytes > 0
+    assert res.remaining_entries == len(list(tmp_path.glob("*.json")))
+
+
+def test_gc_store_entry_quota_and_tmp_reaping(tmp_path):
+    now = time.time()
+    for i in range(6):
+        _write_record(tmp_path, f"r{i}", 256, now - 50 + i)
+    stale_tmp = tmp_path / "w.123.tmp"
+    stale_tmp.write_text("half a rec")
+    os.utime(stale_tmp, (now - 7200, now - 7200))
+    fresh_tmp = tmp_path / "w.456.tmp"
+    fresh_tmp.write_text("live publish in flight")
+    res = gc_store(tmp_path, max_entries=2)
+    assert len(list(tmp_path.glob("*.json"))) == 2
+    assert res.tmp_reaped == 1
+    assert not stale_tmp.exists()
+    assert fresh_tmp.exists()  # never reap a possibly-live writer
+
+
+def test_verify_store_quarantines_damage_once(tmp_path):
+    now = time.time()
+    _write_record(tmp_path, "good", 512, now)
+    (tmp_path / "trunc.json").write_text('{"format_version":')
+    stale = {
+        "format_version": CACHE_FORMAT_VERSION + 999,
+        "model_version": "m", "key": "s", "result": {},
+    }
+    (tmp_path / "stale.json").write_text(json.dumps(stale))
+    old_model = {
+        "format_version": CACHE_FORMAT_VERSION,
+        "model_version": "ancient", "key": "o", "result": {},
+    }
+    (tmp_path / "oldmodel.json").write_text(json.dumps(old_model))
+    res = verify_store(tmp_path, model_version="m")
+    assert res.quarantined_corrupt == 1
+    assert res.quarantined_stale_format == 1
+    # old-model records are well-formed: counted, left for GC to age out
+    assert res.stale_model == 1
+    assert res.ok == 2
+    qdir = tmp_path / QUARANTINE_DIR
+    assert len(list(qdir.iterdir())) == 2
+    # the quota ignores quarantine (it governs the servable tier)
+    stats = scan_store(tmp_path)
+    assert stats.entries == 2 and stats.quarantined == 2
+    removed = clear_store(tmp_path)
+    assert removed == 4  # 2 live + 2 quarantined
+    assert not qdir.exists()
+
+
+def test_verify_store_defaults_to_live_model_stamp(tmp_path):
+    """Calling verify_store without a model_version must resolve the
+    live composite stamp (timing model + parser) — the daemon's startup
+    sweep counts stale records without re-deriving it, so the
+    guard_startup_stale_model gauge actually means something."""
+    cache = ResultCache(disk_dir=tmp_path)
+    cache.put("fresh", EngineResult(cycles=1.0))
+    old = {
+        "format_version": CACHE_FORMAT_VERSION,
+        "model_version": "ancient+parser", "key": "o", "result": {},
+    }
+    (tmp_path / "oldmodel.json").write_text(json.dumps(old))
+    res = verify_store(tmp_path)
+    assert res.ok == 2 and res.quarantined_corrupt == 0
+    assert res.stale_model == 1
+    # empty string = skip the staleness count entirely
+    assert verify_store(tmp_path, model_version="").stale_model == 0
+
+
+def test_result_cache_quota_gc_keeps_store_bounded(tmp_path):
+    """The data-plane path: puts past the quota trigger the LRU GC and
+    the store ends every put at or under the quota; disk hits refresh
+    recency so a USED record outlives an older unused one."""
+    cache_dir = tmp_path / "cache"
+    cache = ResultCache(disk_dir=cache_dir, quota_bytes=6 * 1024)
+    for i in range(24):
+        r = EngineResult(cycles=float(i), op_count=i)
+        cache.put(f"key-{i}", r)
+        assert store_bytes(cache_dir) <= 6 * 1024
+    assert cache.gc_runs >= 1 and cache.gc_deleted > 0
+    g = cache.guard_stats_dict()
+    assert g["store_quota_bytes"] == 6 * 1024
+    assert g["store_gc_deleted_total"] == cache.gc_deleted
+
+
+def test_disk_hit_refreshes_lru_recency(tmp_path):
+    cache_dir = tmp_path / "cache"
+    c = ResultCache(disk_dir=cache_dir)
+    c.put("old-but-used", EngineResult(cycles=1.0))
+    c.put("newer-unused", EngineResult(cycles=2.0))
+    used_path = c._path_for("old-but-used")
+    unused_path = c._path_for("newer-unused")
+    # age both far into the past, the used one older
+    now = time.time()
+    os.utime(used_path, (now - 2000, now - 2000))
+    os.utime(unused_path, (now - 1000, now - 1000))
+    # a fresh cache's disk hit must touch the record's mtime
+    reader = ResultCache(disk_dir=cache_dir)
+    assert reader.get("old-but-used") is not None
+    assert used_path.stat().st_mtime > now - 10
+    # GC to one record: the untouched one dies, the used one survives
+    gc_store(cache_dir, max_entries=1)
+    assert [p.name for p in cache_dir.glob("*.json")] == [used_path.name]
+
+
+def test_shrink_and_compiled_tier_bounds():
+    cache = ResultCache(max_entries=64)
+    for i in range(64):
+        cache.put(f"k{i}", EngineResult(cycles=float(i)))
+    dropped = cache.shrink()
+    assert dropped == 32 and cache.max_entries == 32
+    assert len(cache._mem) == 32
+    assert cache.lru_shrinks == 1
+    # the floor holds
+    for _ in range(10):
+        cache.shrink()
+    assert cache.max_entries == 16
+    # compiled tier: clearing and re-bounding never raises, returns
+    # counts (contents depend on what this process priced before)
+    n = clear_compiled_cache()
+    assert n >= 0
+    set_compiled_cache_max(8)
+    set_compiled_cache_max(256)  # restore the default for later tests
+
+
+# -- multi-process GC chaos (the tentpole's concurrency claim) --------------
+
+def _chaos_worker(idx: int, cache_dir: str, quota: int, q) -> None:
+    try:
+        cache = ResultCache(disk_dir=cache_dir, quota_bytes=quota)
+        torn = 0
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for i in range(60):
+                cache.put(
+                    f"w{idx}-{i}",
+                    EngineResult(cycles=float(i), op_count=i),
+                )
+                # read keys any writer may have published or GC'd:
+                # every outcome must be a clean hit or a clean miss
+                for peer in range(3):
+                    cache.get(f"w{peer}-{max(i - 2, 0)}")
+                if i % 16 == 0:
+                    gc_store(cache_dir, quota_bytes=quota)
+        torn = sum(
+            1 for w in caught if "corrupt result-cache" in str(w.message)
+        )
+        q.put((idx, torn, cache.quarantined, cache.gc_runs))
+    except Exception as e:  # pragma: no cover - failure reporting
+        q.put((idx, f"{type(e).__name__}: {e}", -1, -1))
+
+
+def test_multiprocess_gc_chaos_zero_torn_reads(tmp_path):
+    """Three processes hammer one store under a tight quota — puts,
+    gets of each other's keys, and explicit GCs all racing.  The
+    concurrency contract requires zero torn reads (no corrupt-record
+    warnings, no quarantines) and a final store at or under quota."""
+    cache_dir = tmp_path / "shared"
+    quota = 8 * 1024
+    ctx = multiprocessing.get_context(
+        "fork" if "fork" in multiprocessing.get_all_start_methods()
+        else "spawn"
+    )
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_chaos_worker, args=(i, str(cache_dir), quota, q),
+        )
+        for i in range(3)
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    for idx, torn, quarantined, _gc_runs in results:
+        assert torn == 0, f"worker {idx} saw a torn read: {torn}"
+        assert quarantined == 0, f"worker {idx} quarantined {quarantined}"
+    assert sum(r[3] for r in results) >= 1, "the quota never engaged"
+    gc_store(cache_dir, quota_bytes=quota)
+    assert store_bytes(cache_dir) <= quota
+    assert not (cache_dir / QUARANTINE_DIR).exists()
+
+
+# -- cancel token -----------------------------------------------------------
+
+def test_cancel_token_semantics():
+    tok = CancelToken()
+    assert not tok.cancelled and tok.remaining() is None
+    tok.check()  # live token: no raise
+    tok.cancel("first")
+    tok.cancel("second")  # idempotent; first reason wins
+    assert tok.cancelled
+    with pytest.raises(OperationCancelled, match="first"):
+        tok.check()
+
+    deadline = CancelToken.after(0.02)
+    assert not deadline.cancelled
+    assert 0.0 < deadline.remaining() <= 0.02
+    time.sleep(0.03)
+    assert deadline.cancelled and deadline.remaining() == 0.0
+    with pytest.raises(OperationCancelled, match="deadline"):
+        deadline.check()
+
+
+# -- cancellation through the pricing stack ---------------------------------
+
+@pytest.mark.parametrize("backend", ["serial", "auto"])
+def test_engine_cancellation_both_backends(backend):
+    pod = load_trace(FIXTURES / "llama_tiny_tp2dp2")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5p", tuned=False)
+    tripped = CancelToken()
+    tripped.cancel("stop")
+    eng = Engine(cfg, pricing_backend=backend, cancel=tripped)
+    with pytest.raises(OperationCancelled):
+        eng.run(mod)
+
+
+@pytest.mark.parametrize("backend", ["serial", "auto"])
+def test_armed_token_is_byte_identical(backend):
+    from tpusim.sim.driver import simulate_trace
+
+    plain = simulate_trace(
+        FIXTURES / "llama_tiny_tp2dp2", arch="v5p", tuned=False,
+        pricing_backend=backend,
+    )
+    armed = simulate_trace(
+        FIXTURES / "llama_tiny_tp2dp2", arch="v5p", tuned=False,
+        pricing_backend=backend, cancel=CancelToken.after(600.0),
+    )
+    assert _stats(armed) == _stats(plain)
+
+
+def test_driver_cancels_at_command_grain():
+    from tpusim.sim.driver import SimDriver
+
+    pod = load_trace(FIXTURES / "llama_tiny_tp2dp2")
+    cfg = load_config(arch="v5p", tuned=False)
+    tok = CancelToken()
+    tok.cancel("client went away")
+    with pytest.raises(OperationCancelled, match="client went away"):
+        SimDriver(cfg, cancel=tok).run(pod)
+
+
+def test_guard_stats_ride_reports_only_under_quota(tmp_path):
+    from tpusim.sim.driver import simulate_trace
+
+    plain = simulate_trace(
+        FIXTURES / "matmul_512", arch="v5e", tuned=False,
+        result_cache=ResultCache(disk_dir=tmp_path / "a"),
+    )
+    assert not any(k.startswith("guard_") for k in _stats(plain))
+    governed = simulate_trace(
+        FIXTURES / "matmul_512", arch="v5e", tuned=False,
+        result_cache=ResultCache(
+            disk_dir=tmp_path / "b", quota_bytes=1 << 20,
+        ),
+    )
+    g = _stats(governed)
+    assert g["guard_store_quota_bytes"] == 1 << 20
+    assert "guard_store_gc_runs_total" in g
+    # the governance keys are the ONLY difference
+    assert {k: v for k, v in g.items()
+            if not k.startswith(("guard_", "cache_"))} == \
+           {k: v for k, v in _stats(plain).items()
+            if not k.startswith("cache_")}
+
+
+# -- memory watchdog --------------------------------------------------------
+
+def test_watchdog_ladder_order_and_recovery():
+    cache = ResultCache(max_entries=64)
+    for i in range(64):
+        cache.put(f"k{i}", EngineResult(cycles=float(i)))
+    rss = {"v": 100}
+    shed_flips = []
+    dog = default_ladder(
+        MemoryWatchdog(
+            soft_bytes=200, hard_bytes=400, rss_fn=lambda: rss["v"],
+            on_shed=lambda: shed_flips.append("shed"),
+            on_recover=lambda: shed_flips.append("recover"),
+        ),
+        result_cache=cache,
+    )
+    prev_stream = os.environ.get("TPUSIM_STREAM_THRESHOLD")
+    try:
+        dog.poll_once()
+        assert dog.steps_taken == [] and not dog.shedding
+        rss["v"] = 250  # soft: one step per sample, in ladder order
+        dog.poll_once()
+        assert dog.steps_taken == ["shrink_lru"]
+        assert cache.max_entries == 32
+        dog.poll_once()
+        assert dog.steps_taken == ["shrink_lru", "drop_compiled"]
+        rss["v"] = 500  # hard: every remaining step, then shed
+        dog.poll_once()
+        assert dog.steps_taken[-1] == "force_lean"
+        assert os.environ.get("TPUSIM_STREAM_THRESHOLD") == "0"
+        assert dog.shedding and shed_flips == ["shed"]
+        rss["v"] = 100  # back under the soft line: recover + re-arm
+        dog.poll_once()
+        assert not dog.shedding
+        assert shed_flips == ["shed", "recover"]
+        # recovery UNDID force_lean: one transient spike must not pin
+        # lean streaming for the process lifetime
+        assert os.environ.get("TPUSIM_STREAM_THRESHOLD") == prev_stream
+        # ... and restored the L1 entry budget: repeated transient
+        # excursions must not ratchet a long-lived daemon down to the
+        # shrink floor (the budget is the step's lasting effect;
+        # contents refill on demand)
+        assert cache.max_entries == 64
+        rss["v"] = 250
+        dog.poll_once()  # the ladder re-armed from the top
+        assert dog.steps_taken[-1] == "shrink_lru"
+        assert cache.max_entries == 32  # halved from the RESTORED budget
+        stats = dog.stats_dict()
+        assert stats["rss_peak_bytes"] == 500
+        assert stats["shed_entries_total"] == 1
+        assert stats["recoveries_total"] == 1
+    finally:
+        if prev_stream is None:
+            os.environ.pop("TPUSIM_STREAM_THRESHOLD", None)
+        else:
+            os.environ["TPUSIM_STREAM_THRESHOLD"] = prev_stream
+        set_compiled_cache_max(256)
+
+
+def test_rss_bytes_reads_this_process():
+    rss = rss_bytes()
+    assert rss > 10 * 1024 * 1024  # a live CPython is tens of MB
+
+
+def test_watchdog_samples_current_rss_never_the_peak_fallback():
+    """The watchdog's default sampler must be the current-RSS reader:
+    rss_bytes' ru_maxrss fallback is MONOTONE, and a governor sampling
+    a peak would turn one transient spike into permanent load-shedding
+    with no possible recovery."""
+    from tpusim.guard.watchdog import _rss_current
+
+    dog = MemoryWatchdog(soft_bytes=None, hard_bytes=None)
+    assert dog._rss_fn is _rss_current
+    # the /proc-only reader: live value for us, 0 ("no signal", never
+    # "no memory") for a pid that cannot exist
+    assert _rss_current() > 10 * 1024 * 1024
+    assert _rss_current(1 << 30) == 0
+
+
+# -- campaign: cancel + resume parity (the satellite's contract) ------------
+
+def _campaign_spec() -> dict:
+    return {
+        "name": "guard-parity", "seed": 7, "scenarios": 8,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "faults": {
+            "count": {"dist": "uniform", "min": 0, "max": 2},
+            "kinds": {"link_down": 1.0, "chip_straggler": 0.5},
+            "scale": {"min": 0.4, "max": 0.9},
+        },
+    }
+
+
+def test_campaign_cancel_then_resume_byte_identical(tmp_path):
+    """Cancel mid-campaign at scenario grain, resume, and the final
+    report must be byte-identical to an uninterrupted run — with the
+    resumed run re-pricing ONLY the scenarios the cancel pre-empted."""
+    from tpusim.campaign import run_campaign
+    from tpusim.campaign.journal import Journal
+
+    full = run_campaign(
+        _campaign_spec(), trace_path=FIXTURES / "llama_tiny_tp2dp2",
+        out_dir=tmp_path / "full",
+    )
+
+    tok = CancelToken()
+    done = {"n": 0}
+
+    def progress(msg: str) -> None:
+        done["n"] += 1
+        if done["n"] == 3:
+            tok.cancel("operator cancel")
+
+    with pytest.raises(OperationCancelled, match="operator cancel"):
+        run_campaign(
+            _campaign_spec(), trace_path=FIXTURES / "llama_tiny_tp2dp2",
+            out_dir=tmp_path / "cut", cancel=tok, progress=progress,
+        )
+    recs = Journal(tmp_path / "cut").read_records()
+    assert [r["kind"] for r in recs] == \
+        ["header", "healthy"] + ["scenario"] * 3
+
+    resumed = run_campaign(
+        _campaign_spec(), trace_path=FIXTURES / "llama_tiny_tp2dp2",
+        out_dir=tmp_path / "cut", resume=True,
+    )
+    assert resumed.stats.resumed == 3
+    assert resumed.stats.priced + resumed.stats.partitioned + \
+        resumed.stats.failed <= 5
+    want = json.dumps(full.doc, sort_keys=True)
+    got = json.dumps(resumed.doc, sort_keys=True)
+    assert got == want, "resumed report diverged from uninterrupted run"
+    # the report files on disk match byte for byte too
+    assert (tmp_path / "cut" / "report.json").read_bytes() == \
+        (tmp_path / "full" / "report.json").read_bytes()
+
+
+def test_journal_iteration_is_lazy(tmp_path):
+    """iter_records streams: records before a mid-file corruption are
+    yielded before the damage is even read — the O(1)-memory resume
+    path for 10^5-scenario campaigns."""
+    from tpusim.campaign.journal import Journal, JournalError
+
+    j = Journal(tmp_path)
+    j.append({"kind": "header", "spec_hash": "h", "seed": 1,
+              "model_version": "m"})
+    j.append({"kind": "scenario", "slice": "s", "index": 0, "row": {}})
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(b"garbage not json\n")
+    it = Journal(tmp_path).iter_records()
+    assert next(it)["kind"] == "header"
+    assert next(it)["kind"] == "scenario"  # yielded BEFORE the damage
+    with pytest.raises(JournalError, match="corrupt"):
+        next(it)
+
+
+# -- serve: in-process cooperative 504 + job cancellation -------------------
+
+def test_serve_worker_simulate_cancels_in_process():
+    from tpusim.serve.registry import TraceRegistry
+    from tpusim.serve.worker import ServeWorker
+
+    worker = ServeWorker(
+        TraceRegistry(FIXTURES), result_cache=ResultCache(),
+    )
+    tok = CancelToken()
+    tok.cancel("deadline")
+    with pytest.raises(OperationCancelled):
+        worker.simulate(
+            {"trace": "matmul_512", "arch": "v5e", "tuned": False},
+            cancel=tok,
+        )
+
+
+def test_sweep_jobs_are_cancellable_at_link_grain():
+    """Sweep was the one job kind ``DELETE /v1/jobs/<id>`` could not
+    actually stop: the token tripped, the table answered 'cancelling',
+    and the sweep priced to terminal 'done' anyway.  Both sweep
+    flavors and the serve worker must honor the token now."""
+    from tpusim.faults.sweep import single_link_sweep, trace_step_sweep
+    from tpusim.ici.topology import torus_for
+    from tpusim.serve.registry import TraceRegistry
+    from tpusim.serve.worker import ServeWorker
+
+    tok = CancelToken()
+    tok.cancel("client DELETE")
+    cfg = load_config(arch="v5p", tuned=False)
+    topo = torus_for(8, cfg.arch.name)
+    with pytest.raises(OperationCancelled):
+        single_link_sweep(topo, cfg.arch.ici, cancel=tok)
+    with pytest.raises(OperationCancelled):
+        trace_step_sweep(
+            str(FIXTURES / "llama_tiny_tp2dp2"), topo, config=cfg,
+            cancel=tok,
+        )
+    worker = ServeWorker(
+        TraceRegistry(FIXTURES), result_cache=ResultCache(),
+    )
+    with pytest.raises(OperationCancelled):
+        worker.sweep({"arch": "v5p", "chips": 8}, cancel=tok)
+    # an armed-but-untripped token changes nothing: the sweep completes
+    live = single_link_sweep(
+        torus_for(8, cfg.arch.name), cfg.arch.ici,
+        cancel=CancelToken(),
+    )
+    bare = single_link_sweep(torus_for(8, cfg.arch.name), cfg.arch.ici)
+    assert live.to_doc() == bare.to_doc()
+
+
+def test_inprocess_daemon_coop_cancel_504():
+    """Single-process daemon: a cold pricing run that outlives its
+    deadline must 504 through the in-process CancelToken (detail names
+    the cooperative cancel), and the daemon keeps serving."""
+    import http.client
+
+    from tpusim.serve.daemon import ServeDaemon
+
+    with ServeDaemon(trace_root=FIXTURES) as d:
+        conn = http.client.HTTPConnection(d.host, d.port, timeout=30)
+        try:
+            # cold llama pricing takes hundreds of ms; 50ms of budget
+            # comfortably clears admission and trips mid-walk
+            conn.request(
+                "POST", "/v1/simulate",
+                json.dumps({
+                    "trace": "llama_tiny_tp2dp2", "arch": "v5p",
+                    "tuned": False, "deadline_ms": 50,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            doc = json.loads(resp.read())
+            assert resp.status == 504
+            assert "cooperative" in doc["detail"]
+            # the daemon survives and prices the same request fine
+            conn.request(
+                "POST", "/v1/simulate",
+                json.dumps({
+                    "trace": "matmul_512", "arch": "v5e", "tuned": False,
+                }),
+                {"Content-Type": "application/json"},
+            )
+            resp2 = conn.getresponse()
+            assert resp2.status == 200
+            resp2.read()
+        finally:
+            conn.close()
+
+
+def test_jobtable_cancel_queued_and_running(tmp_path):
+    from tpusim.serve.admission import JobTable
+
+    table = JobTable(queue_depth=4, persist_dir=tmp_path / "jobs")
+    queued = table.submit("sweep", {"arch": "v5p"})
+    running = table.submit("campaign", {"spec": {}})
+    assert table.cancel("job-999999") is None
+    # cancel while queued: terminal immediately
+    assert table.cancel(queued.job_id) == "cancelled"
+    assert table.get(queued.job_id).status == "cancelled"
+    # a worker picks up the other job (the queued-cancelled one is gone
+    # from the line)
+    job = table.next_job(timeout_s=0.1)
+    assert job is running and job.status == "running"
+    # cancel while running: the token trips, the loop lands it terminal
+    assert table.cancel(running.job_id) == "cancelling"
+    assert running.cancel_token.cancelled
+    table.finish(job, None, "cancelled: client asked",
+                 status="cancelled")
+    assert table.get(running.job_id).status == "cancelled"
+    # cancelled is terminal for drain purposes
+    assert table.wait_idle(timeout_s=1.0)
+    # and persisted terminally: a recovering table retains, not re-runs
+    table2 = JobTable(queue_depth=4, persist_dir=tmp_path / "jobs")
+    assert table2.get(queued.job_id).status == "cancelled"
+    assert table2.get(running.job_id).status == "cancelled"
+    assert table2.recovered == 0
+
+
+def test_statskeys_guard_namespace_registered():
+    from tpusim.analysis.statskeys import (
+        AUDIT_GLOBS, STATS_NAMESPACES,
+    )
+
+    assert "guard_" in STATS_NAMESPACES
+    assert "tpusim/guard/" in STATS_NAMESPACES["guard_"]
+    assert "tpusim/guard/*.py" in AUDIT_GLOBS

@@ -14,8 +14,6 @@ import json
 import os
 from pathlib import Path
 
-import pytest
-
 from tpusim.perf.cache import (
     CachedEngine,
     ResultCache,
@@ -296,6 +294,15 @@ def test_disk_cache_warm_run_skips_engine(tmp_path, monkeypatch):
 
 
 def test_corrupt_disk_entry_recomputes_with_warning(tmp_path):
+    """tpusim.guard regression: a corrupt record warns EXACTLY ONCE —
+    first detection quarantines the file off the lookup path, so the
+    recompute's put heals it permanently instead of every later lookup
+    warning again (pre-guard, a racing pre-scan + engine get produced
+    two warnings per run, and a failed healing put warned forever)."""
+    import warnings as _warnings
+
+    from tpusim.guard.store import QUARANTINE_DIR
+
     pod = load_trace(FIXTURES / "matmul_512")
     mod = next(iter(pod.modules.values()))
     cfg = load_config(arch="v5e", tuned=False)
@@ -309,12 +316,30 @@ def test_corrupt_disk_entry_recomputes_with_warning(tmp_path):
     entries[0].write_text(entries[0].read_text()[: 40])
 
     c2 = ResultCache(disk_dir=cache_dir)
-    with pytest.warns(RuntimeWarning, match="corrupt result-cache"):
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
         r2 = CachedEngine(cfg, result_cache=c2).run(mod)
+        # a second lookup through a FRESH cache (no in-memory hit, no
+        # local state) must find a healed record, not the corpse
+        c2b = ResultCache(disk_dir=cache_dir)
+        r2b = CachedEngine(cfg, result_cache=c2b).run(mod)
+    corrupt_warnings = [
+        w for w in caught if "corrupt result-cache" in str(w.message)
+    ]
+    assert len(corrupt_warnings) == 1, (
+        f"expected exactly one corrupt-record warning, got "
+        f"{[str(w.message) for w in corrupt_warnings]}"
+    )
     assert c2.disk_errors == 1
     assert c2.misses == 1 and c2.hits == 0
+    assert c2.quarantined == 1
     assert r2.cycles == r1.cycles  # recomputed, not garbage
-    # the recompute healed the record: a third cache disk-hits it
+    # the corpse moved into quarantine for post-mortems
+    qdir = cache_dir / QUARANTINE_DIR
+    assert qdir.is_dir() and len(list(qdir.iterdir())) == 1
+    # the recompute healed the record: the fresh cache disk-hit it
+    assert c2b.disk_hits == 1
+    assert r2b.cycles == r1.cycles
     c3 = ResultCache(disk_dir=cache_dir)
     r3 = CachedEngine(cfg, result_cache=c3).run(mod)
     assert c3.disk_hits == 1

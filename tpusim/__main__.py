@@ -48,13 +48,69 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         from tpusim.faults import load_fault_schedule
 
         faults = load_fault_schedule(args.faults)
-    report = simulate_trace(
-        args.trace, arch=args.arch, overlays=overlays, obs=obs,
-        faults=faults, lenient=args.lenient_parse,
-        validate=args.validate,
-        result_cache=args.result_cache, workers=args.workers,
-        pricing_backend=args.pricing_backend,
-    )
+    # tpusim.guard: --cache-quota bounds the disk result cache (implies
+    # --result-cache), --max-wall-s arms a cooperative deadline token,
+    # --max-rss mounts the memory watchdog whose terminal shed step
+    # cancels the run cleanly instead of meeting the OOM-killer
+    result_cache = args.result_cache
+    cancel = None
+    watchdog = None
+    try:
+        if getattr(args, "cache_quota", None):
+            from tpusim.guard.store import parse_size
+            from tpusim.perf.cache import as_result_cache
+
+            result_cache = as_result_cache(
+                True if result_cache is None else result_cache
+            )
+            result_cache.quota_bytes = parse_size(args.cache_quota)
+        if getattr(args, "max_wall_s", None):
+            from tpusim.guard.cancel import CancelToken
+
+            cancel = CancelToken.after(args.max_wall_s)
+        if getattr(args, "max_rss", None):
+            from tpusim.guard.cancel import CancelToken
+            from tpusim.guard.store import parse_size
+            from tpusim.guard.watchdog import MemoryWatchdog, default_ladder
+            from tpusim.perf.cache import as_result_cache
+
+            if cancel is None:
+                cancel = CancelToken()
+            # the ladder's shrink step needs the LIVE ResultCache, not
+            # the raw flag value (True / a dir path) — coerce here and
+            # hand the same instance to simulate_trace below
+            result_cache = as_result_cache(result_cache)
+            watchdog = default_ladder(
+                MemoryWatchdog(
+                    soft_bytes=None,
+                    hard_bytes=parse_size(args.max_rss),
+                    on_shed=lambda: cancel.cancel(
+                        "RSS passed --max-rss with every droppable "
+                        "store already shed"
+                    ),
+                ),
+                result_cache=result_cache,
+            ).start()
+    except ValueError as e:
+        print(f"tpusim: error: {e}", file=sys.stderr)
+        return 2
+    from tpusim.guard.cancel import OperationCancelled
+
+    try:
+        report = simulate_trace(
+            args.trace, arch=args.arch, overlays=overlays, obs=obs,
+            faults=faults, lenient=args.lenient_parse,
+            validate=args.validate,
+            result_cache=result_cache, workers=args.workers,
+            pricing_backend=args.pricing_backend, cancel=cancel,
+        )
+    except OperationCancelled as e:
+        # the clean refusal: nothing half-written, caches warm on disk
+        print(f"tpusim simulate: cancelled: {e}", file=sys.stderr)
+        return 3
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     if args.power and report.power is not None:
         print(report.power.report_text())
     if obs is not None:
@@ -387,6 +443,55 @@ def _cmd_faults(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_cache(args: argparse.Namespace) -> int:
+    """Governance front end for a disk result-cache store
+    (tpusim.guard): inspect it, verify + quarantine damaged records,
+    garbage-collect it down to a quota, or clear it."""
+    from tpusim.guard.store import (
+        clear_store, format_size, gc_store, parse_size, scan_store,
+        verify_store,
+    )
+    from tpusim.perf.cache import DEFAULT_CACHE_DIR
+
+    d = Path(args.dir or DEFAULT_CACHE_DIR)
+    if args.action != "stats" and not d.is_dir():
+        print(f"tpusim cache: no store at {d}", file=sys.stderr)
+        return 1
+    if args.action == "stats":
+        for line in scan_store(d).lines():
+            print(line)
+        return 0
+    if args.action == "verify":
+        res = verify_store(d)
+        print(f"store: {d}")
+        for line in res.lines():
+            print(line)
+        return 0
+    if args.action == "gc":
+        try:
+            quota = parse_size(args.quota)
+        except ValueError as e:
+            print(f"tpusim cache: error: {e}", file=sys.stderr)
+            return 2
+        if quota is None and args.max_entries is None:
+            print("tpusim cache: gc needs --quota and/or --max-entries "
+                  "(otherwise there is nothing to collect down to)",
+                  file=sys.stderr)
+            return 2
+        res = gc_store(d, quota_bytes=quota, max_entries=args.max_entries)
+        print(f"store: {d}")
+        print(f"  deleted: {res.deleted} record(s) "
+              f"({format_size(res.freed_bytes)} freed)")
+        print(f"  reaped: {res.tmp_reaped} abandoned tmp file(s)")
+        print(f"  remaining: {res.remaining_entries} record(s) "
+              f"({format_size(res.remaining_bytes)})")
+        return 0
+    # clear
+    removed = clear_store(d)
+    print(f"store: {d}\n  removed: {removed} file(s)")
+    return 0
+
+
 def _cmd_campaign(args: argparse.Namespace) -> int:
     """Monte-Carlo compound-fault campaign: sample N fault scenarios
     per pod slice from a seeded spec, price each through the shared
@@ -395,11 +500,15 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
     killed campaign from its last journaled scenario."""
     from tpusim.analysis import ValidationError
     from tpusim.campaign import JournalError, run_campaign
+    from tpusim.guard.cancel import CancelToken, OperationCancelled
 
     progress = None
     if args.verbose:
         def progress(msg: str) -> None:
             print(f"  {msg}", file=sys.stderr)
+    cancel = None
+    if getattr(args, "max_wall_s", None):
+        cancel = CancelToken.after(args.max_wall_s)
     try:
         res = run_campaign(
             args.spec,
@@ -409,7 +518,16 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
             result_cache=args.result_cache,
             workers=args.workers,
             progress=progress,
+            cancel=cancel,
         )
+    except OperationCancelled as e:
+        hint = (
+            f"re-run with --resume --out {args.out} to continue from "
+            f"the last journaled scenario" if args.out
+            else "pass --out DIR to make cancelled campaigns resumable"
+        )
+        print(f"tpusim campaign: cancelled: {e}; {hint}", file=sys.stderr)
+        return 3
     except ValidationError as e:
         print(f"tpusim campaign: spec refused:\n{e}", file=sys.stderr)
         return 1
@@ -541,26 +659,34 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     engine-result cache, and SIGTERM drain."""
     from tpusim.serve.daemon import ServeDaemon
 
-    daemon = ServeDaemon(
-        trace_root=args.trace_root,
-        host=args.host,
-        port=args.port,
-        max_inflight=args.max_inflight,
-        queue_depth=args.queue_depth,
-        deadline_s=args.deadline_s,
-        max_request_bytes=args.max_request_bytes,
-        result_cache=args.result_cache,
-        workers=args.workers or 1,
-        serve_workers=args.serve_workers,
-        min_workers=args.serve_min_workers,
-        # clamp at 1: job_workers=0 is the in-process test hook (accept
-        # + persist jobs without draining them); a served daemon must
-        # always drain its queue
-        job_workers=max(args.job_workers, 1),
-        drain_grace_s=args.drain_grace_s,
-        state_dir=args.state_dir,
-        verbose=args.verbose,
-    )
+    try:
+        daemon = ServeDaemon(
+            trace_root=args.trace_root,
+            host=args.host,
+            port=args.port,
+            max_inflight=args.max_inflight,
+            queue_depth=args.queue_depth,
+            deadline_s=args.deadline_s,
+            max_request_bytes=args.max_request_bytes,
+            result_cache=args.result_cache,
+            workers=args.workers or 1,
+            serve_workers=args.serve_workers,
+            min_workers=args.serve_min_workers,
+            # clamp at 1: job_workers=0 is the in-process test hook
+            # (accept + persist jobs without draining them); a served
+            # daemon must always drain its queue
+            job_workers=max(args.job_workers, 1),
+            drain_grace_s=args.drain_grace_s,
+            state_dir=args.state_dir,
+            verbose=args.verbose,
+            cache_quota=args.cache_quota,
+            max_rss=args.max_rss,
+            max_worker_rss=args.max_worker_rss,
+        )
+    except ValueError as e:
+        # a quota/size typo must refuse loudly, not bound nothing
+        print(f"tpusim serve: error: {e}", file=sys.stderr)
+        return 2
     daemon.install_signal_handlers()
     daemon.start()
     # the bound port line is the startup contract: --port 0 asks the
@@ -1039,6 +1165,20 @@ def main(argv: list[str] | None = None) -> int:
                          "default dir .tpusim_cache/): a warm re-run "
                          "prices nothing and reproduces the same stats "
                          "byte-for-byte; stamps cache_* stats")
+    ps.add_argument("--cache-quota", default=None, metavar="SIZE",
+                    help="bound the disk result cache (e.g. 512M, 2G); "
+                         "implies --result-cache and garbage-collects "
+                         "least-recently-used records past the quota "
+                         "(tpusim.guard)")
+    ps.add_argument("--max-wall-s", type=float, default=None, metavar="S",
+                    help="cooperative wall-clock budget: the replay "
+                         "cancels cleanly at the next command/op "
+                         "boundary once S seconds elapse (exit 3)")
+    ps.add_argument("--max-rss", default=None, metavar="SIZE",
+                    help="memory watchdog hard threshold (e.g. 4G): "
+                         "past it the degradation ladder sheds caches "
+                         "and finally cancels the run cleanly instead "
+                         "of meeting the OOM-killer")
     ps.add_argument("--validate", nargs="?", const="on", default=None,
                     choices=["on", "strict"], metavar="on|strict",
                     help="pre-flight the trace/config/schedule through "
@@ -1199,6 +1339,28 @@ def main(argv: list[str] | None = None) -> int:
                           "tier)")
     pfa.set_defaults(fn=_cmd_faults)
 
+    pca = sub.add_parser(
+        "cache",
+        help="govern a disk result-cache store (tpusim.guard): stats / "
+             "verify (quarantine damaged records) / gc (LRU-collect to "
+             "a quota) / clear",
+    )
+    pca.add_argument("action", choices=["stats", "verify", "gc", "clear"],
+                     help="stats: one scan summary; verify: integrity "
+                          "sweep quarantining corrupt/stale-format "
+                          "records; gc: delete least-recently-used "
+                          "records down to --quota/--max-entries; "
+                          "clear: remove everything incl. quarantine")
+    pca.add_argument("--dir", default=None, metavar="DIR",
+                     help="store directory (default: the "
+                          "--result-cache default, .tpusim_cache/)")
+    pca.add_argument("--quota", default=None, metavar="SIZE",
+                     help="gc: byte quota to collect down to "
+                          "(e.g. 512M, 2G)")
+    pca.add_argument("--max-entries", type=int, default=None, metavar="N",
+                     help="gc: record-count quota to collect down to")
+    pca.set_defaults(fn=_cmd_cache)
+
     pcm = sub.add_parser(
         "campaign",
         help="seeded Monte-Carlo compound-fault campaign: N sampled "
@@ -1226,6 +1388,11 @@ def main(argv: list[str] | None = None) -> int:
                      help="share the engine-result cache on disk "
                           "(in-memory sharing across scenarios is "
                           "always on; this persists it across runs)")
+    pcm.add_argument("--max-wall-s", type=float, default=None, metavar="S",
+                     help="cooperative wall-clock budget: the campaign "
+                          "cancels at the next scenario boundary with "
+                          "everything completed journaled — --resume "
+                          "re-prices nothing (exit 3)")
     pcm.add_argument("--json", default=None,
                      help="also write the report document here")
     pcm.add_argument("--verbose", action="store_true",
@@ -1321,6 +1488,22 @@ def main(argv: list[str] | None = None) -> int:
                           "campaign journals) here: a restarted daemon "
                           "re-enqueues queued/running jobs and resumes "
                           "campaigns from their last completed scenario")
+    psv.add_argument("--cache-quota", default=None, metavar="SIZE",
+                     help="bound the shared disk result cache (e.g. "
+                          "2G); the daemon AND every serve-worker "
+                          "garbage-collect least-recently-used records "
+                          "past it (tpusim.guard)")
+    psv.add_argument("--max-rss", default=None, metavar="SIZE",
+                     help="daemon memory watchdog hard threshold: past "
+                          "it the degradation ladder shrinks caches, "
+                          "drops the compiled tier, forces lean "
+                          "streaming, then sheds load (503 + "
+                          "Retry-After) instead of meeting the "
+                          "OOM-killer")
+    psv.add_argument("--max-worker-rss", default=None, metavar="SIZE",
+                     help="per-worker RSS cap (serve-workers mode): an "
+                          "over-budget idle worker is restarted "
+                          "deliberately between requests")
     psv.add_argument("--verbose", action="store_true",
                      help="per-request access log on stderr")
     psv.set_defaults(fn=_cmd_serve)

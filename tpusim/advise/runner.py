@@ -172,6 +172,7 @@ def run_advise(
     workers: int | None = None,
     validate: bool = True,
     progress=None,
+    cancel=None,
 ) -> AdviseResult:
     """Execute one advise sweep end to end.
 
@@ -182,7 +183,10 @@ def run_advise(
     (None = fresh in-memory cache); ``workers`` fans each replay's
     module pricing.  ``validate`` runs the TL22x advise passes first
     and refuses on errors — a broken spec must fail before cell 0
-    prices."""
+    prices.  ``cancel`` (a :class:`tpusim.guard.CancelToken`) cancels
+    cooperatively at cell grain (``DELETE /v1/jobs/<id>`` in serve);
+    cells already priced sit warm in the shared cache, so a re-run
+    re-prices nothing they covered."""
     from tpusim.ici.topology import torus_for
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.sim.driver import SimDriver
@@ -225,6 +229,10 @@ def run_advise(
     rows: list[dict] = []
     skipped: list[dict] = []
     for cell in cells:
+        # cell-grain cancellation (tpusim.guard): the shared cache keeps
+        # every already-priced cell warm across a cancel + re-run
+        if cancel is not None:
+            cancel.check()
         stats.cells += 1
         degrees = dict(cell.degrees)
         if degrees.get("ep", 1) > 1 and not profile.ep_sites:

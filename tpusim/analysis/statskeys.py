@@ -97,6 +97,15 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/fastpath/", "tpusim/sim/driver.py", "tpusim/__main__.py",
         "bench.py", "ci/check_golden.py",
     ),
+    # resource governance (tpusim.guard): store-quota/GC accounting,
+    # memory-watchdog gauges, cooperative-cancellation counters —
+    # stamped on reports ONLY when a quota is actually governing, and
+    # on /metrics only when a guard feature (quota / --max-rss /
+    # startup sweep) is active; un-governed runs stay key-identical
+    "guard_": (
+        "tpusim/guard/", "tpusim/perf/", "tpusim/sim/driver.py",
+        "tpusim/serve/", "tpusim/__main__.py", "ci/check_golden.py",
+    ),
     # the sharding advisor (PR 7): strategy-sweep executor accounting
     # (cells priced/skipped/feasible) — stamped only when an advise
     # sweep actually ran (the faults_* discipline: healthy simulate
@@ -147,6 +156,7 @@ AUDIT_GLOBS = (
     "tpusim/serve/*.py",
     "tpusim/campaign/*.py",
     "tpusim/advise/*.py",
+    "tpusim/guard/*.py",
     "tpusim/timing/engine.py",
 )
 

@@ -48,45 +48,46 @@ class Journal:
 
     # -- reading -----------------------------------------------------------
 
-    def read_records(self) -> list[dict]:
-        """Every complete record currently on disk.  A trailing partial
-        line (torn write from a crash) is dropped silently; a corrupt
-        line in the MIDDLE raises — that is damage, not a crash
-        artifact."""
+    def iter_records(self):
+        """Lazily yield every complete record currently on disk, one
+        line at a time — a 10^5-scenario campaign resumes in O(1 record)
+        memory instead of materializing the whole JSONL (tpusim.guard).
+        A trailing partial line (torn write from a crash) is dropped
+        silently; a corrupt line in the MIDDLE raises — that is damage,
+        not a crash artifact."""
         if not self.path.is_file():
-            return []
-        raw = self.path.read_bytes()
-        if not raw:
-            return []
-        lines = raw.split(b"\n")
-        tail_complete = raw.endswith(b"\n")
-        if tail_complete:
-            lines = lines[:-1]          # the empty split artifact
-        out: list[dict] = []
-        for i, line in enumerate(lines):
-            last = i == len(lines) - 1
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                if last and not tail_complete:
-                    break               # torn final append: re-price it
-                raise JournalError(
-                    f"{self.path}: corrupt journal line {i + 1} "
-                    f"(not a crash artifact — refusing to guess)"
-                )
-            if last and not tail_complete:
+            return
+        with open(self.path, "rb") as fh:
+            for lineno, raw in enumerate(fh, 1):
+                # a line missing its terminating newline is the torn
+                # final append of a crash (file iteration only ever
+                # yields such a line LAST)
+                complete = raw.endswith(b"\n")
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    if not complete:
+                        return          # torn final append: re-price it
+                    raise JournalError(
+                        f"{self.path}: corrupt journal line {lineno} "
+                        f"(not a crash artifact — refusing to guess)"
+                    )
                 # complete JSON but no newline: the write made it, the
                 # newline flush did not — still a usable record
-                pass
-            if not isinstance(rec, dict) or "kind" not in rec:
-                raise JournalError(
-                    f"{self.path}: journal line {i + 1} is not a "
-                    f"record object"
-                )
-            out.append(rec)
-        return out
+                if not isinstance(rec, dict) or "kind" not in rec:
+                    raise JournalError(
+                        f"{self.path}: journal line {lineno} is not a "
+                        f"record object"
+                    )
+                yield rec
+
+    def read_records(self) -> list[dict]:
+        """Every complete record, materialized (small journals / tests);
+        resume paths iterate :meth:`iter_records` instead."""
+        return list(self.iter_records())
 
     # -- writing -----------------------------------------------------------
 
@@ -121,27 +122,30 @@ class Journal:
             )
         self.append({"kind": "header", "v": JOURNAL_VERSION, **header})
 
-    def open_resume(self, header: dict) -> tuple[dict, list[dict]]:
+    def open_resume(self, header: dict):
         """Resume: validate the on-disk header against ``header`` and
-        return ``(header_record, completed_records)``.  An empty or
-        missing journal degrades to a fresh start."""
-        records = self.read_records()
-        if not records:
+        return ``(header_record, completed_records_iterator)`` — the
+        records stream lazily (O(1) memory however long the campaign
+        ran).  An empty or missing journal degrades to a fresh start."""
+        it = self.iter_records()
+        head = next(it, None)
+        if head is None:
             self.open_fresh(header)
-            return {"kind": "header", "v": JOURNAL_VERSION, **header}, []
-        head = records[0]
+            return {"kind": "header", "v": JOURNAL_VERSION, **header}, iter(())
         if head.get("kind") != "header":
+            it.close()
             raise JournalError(
                 f"{self.path}: first record is not a header"
             )
         for key in ("spec_hash", "seed", "model_version"):
             if head.get(key) != header.get(key):
+                it.close()
                 raise JournalError(
                     f"{self.path}: journal {key} {head.get(key)!r} does "
                     f"not match this campaign's {header.get(key)!r} — "
                     f"refusing to resume a different campaign"
                 )
-        return head, records[1:]
+        return head, it
 
     def __enter__(self) -> "Journal":
         return self

@@ -266,6 +266,7 @@ def run_campaign(
     validate: bool = True,
     progress=None,
     sleep=time.sleep,
+    cancel=None,
 ) -> CampaignResult:
     """Execute one campaign end to end.
 
@@ -277,7 +278,13 @@ def run_campaign(
     is shared across every replay (None = fresh in-memory cache);
     ``workers`` fans each replay's module pricing (scenarios themselves
     run serially so the journal is always a true prefix).  ``validate``
-    runs the TL2xx campaign passes first and refuses on errors."""
+    runs the TL2xx campaign passes first and refuses on errors.
+    ``cancel`` (a :class:`tpusim.guard.CancelToken`) makes the campaign
+    cooperatively cancellable at scenario grain: a tripped token raises
+    :class:`tpusim.guard.OperationCancelled` with every completed
+    scenario already journaled, so a later ``resume=True`` re-prices
+    nothing that finished — the serve tier's ``DELETE /v1/jobs/<id>``
+    and the CLI's ``--max-wall-s`` both arrive here."""
     from tpusim.ici.topology import torus_for
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.timing.config import load_config
@@ -351,6 +358,8 @@ def run_campaign(
     rows_by_slice: dict[str, list[dict]] = {}
     try:
         for sl in spec.slices(default_chips):
+            if cancel is not None:
+                cancel.check()
             stats.slices += 1
             cfg = load_config(
                 arch=sl.arch, overlays=[{"power_enabled": True}],
@@ -382,6 +391,12 @@ def run_campaign(
             })
             rows = rows_by_slice.setdefault(sl.label, [])
             for i in range(spec.scenarios):
+                # scenario-grain cancellation: everything journaled so
+                # far stays durable; the raise reaches the caller with
+                # the journal closed (the finally below) and a later
+                # --resume re-prices nothing already completed
+                if cancel is not None:
+                    cancel.check()
                 stats.scenarios += 1
                 prior = completed.get((sl.label, i))
                 if prior is not None:

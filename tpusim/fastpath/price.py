@@ -177,13 +177,18 @@ class _Ctx:
         "np", "cm", "coll", "backend", "per_op", "views",
         "arch", "config", "degraded", "cs", "hs", "spill_frac",
         "hbm_bpc", "vmem_bpc", "overhead", "dma_lat", "contend",
-        "overlap",
+        "overlap", "cancel",
     )
 
     def __init__(self, engine, cm, coll, spill_frac, backend, per_op):
         import numpy
 
         self.np = numpy
+        # cooperative cancellation (tpusim.guard): checked between
+        # compiled blocks — the fastpath's natural grain (a `run` block
+        # collapses hundreds of ops into one scan, so per-op checks
+        # would defeat the vectorization the backend exists for)
+        self.cancel = engine.cancel
         self.cm = cm
         self.coll = coll
         self.backend = backend
@@ -347,8 +352,11 @@ def _price_computation(ctx, comp_name: str, t0: float, result, depth: int
     dma_names: set[str] = set()
     dma_busy_until = t0
     dma_segments: list[list[float]] = []
+    cancel = ctx.cancel
 
     for step in cc.steps:
+        if cancel is not None:
+            cancel.check()
         kind = step[0]
 
         # ---- clean run of ordinary sync ops ---------------------------

@@ -1,0 +1,62 @@
+"""tpusim.guard — resource governance across the stack.
+
+Three disciplines the production north star requires and nothing
+enforced before this layer:
+
+* **bounded durable stores** (`tpusim.guard.store`): byte/count quotas
+  with crash-safe LRU GC and integrity sweeps for the disk result
+  cache — reached via ``ResultCache(quota_bytes=...)``, the
+  ``--cache-quota`` flags, and the ``tpusim cache`` subcommand;
+* **memory governance** (`tpusim.guard.watchdog`): an RSS sampler with
+  a soft/hard degradation ladder (shrink LRUs → drop compiled tier →
+  force lean streaming → shed load) — the ``--max-rss`` flags; the
+  serve supervisor uses the same primitive for per-worker caps;
+* **cooperative cancellation** (`tpusim.guard.cancel`): a
+  deadline/cancel token checked at command grain in the driver, every
+  :data:`~tpusim.guard.cancel.CHECK_EVERY_OPS` ops in the serial
+  engine walk, and between compiled blocks in the fastpath — serve
+  deadlines 504 in-process with the worker's caches warm,
+  ``DELETE /v1/jobs/<id>`` cancels campaign/advise jobs, and
+  ``--max-wall-s`` bounds CLI runs; SIGTERM/SIGKILL is the escalation,
+  not the first resort.
+
+The healthy path contract matches every prior layer: guard off means
+zero added work and zero added stats keys; guard on keeps priced
+results byte-identical (quotas and cancellation change *whether* and
+*when* work runs, never its arithmetic — CI-enforced by
+``ci/check_golden.py --guard-smoke``).
+"""
+
+from tpusim.guard.cancel import CHECK_EVERY_OPS, CancelToken, OperationCancelled
+from tpusim.guard.store import (
+    GCResult,
+    StoreStats,
+    VerifyResult,
+    clear_store,
+    format_size,
+    gc_store,
+    parse_size,
+    scan_store,
+    store_bytes,
+    verify_store,
+)
+from tpusim.guard.watchdog import MemoryWatchdog, default_ladder, rss_bytes
+
+__all__ = [
+    "CHECK_EVERY_OPS",
+    "CancelToken",
+    "GCResult",
+    "MemoryWatchdog",
+    "OperationCancelled",
+    "StoreStats",
+    "VerifyResult",
+    "clear_store",
+    "default_ladder",
+    "format_size",
+    "gc_store",
+    "parse_size",
+    "rss_bytes",
+    "scan_store",
+    "store_bytes",
+    "verify_store",
+]

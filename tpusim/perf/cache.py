@@ -58,8 +58,10 @@ __all__ = [
     "DEFAULT_CACHE_DIR",
     "ResultCache",
     "as_result_cache",
+    "clear_compiled_cache",
     "compiled_cache_stats",
     "compiled_for",
+    "set_compiled_cache_max",
     "config_fingerprint",
     "module_fingerprint",
     "module_uses_ici",
@@ -305,9 +307,26 @@ class ResultCache:
         max_entries: int = 1024,
         obs=None,
         durable: bool = False,
+        quota_bytes: int | None = None,
+        quota_entries: int | None = None,
     ):
         self.disk_dir = Path(disk_dir) if disk_dir else None
         self.max_entries = max(int(max_entries), 1)
+        # tpusim.guard: byte/count quota on the disk tier.  None = the
+        # pre-guard unbounded behavior (zero added work, zero added
+        # stats keys).  With a quota, every put that pushes the store's
+        # estimated size past it triggers a crash-safe LRU GC
+        # (whole-record deletes by mtime; disk hits touch mtime so
+        # recency is usage, not write order) — safe under a daemon +
+        # N forked workers sharing the dir because deletes are
+        # idempotent and reads treat a vanished file as a plain miss.
+        self.quota_bytes = int(quota_bytes) if quota_bytes else None
+        self.quota_entries = int(quota_entries) if quota_entries else None
+        # local running estimate of the store size; refreshed to the
+        # authoritative scan on every GC.  Own puts only — a peer's
+        # puts trigger the peer's own GC.
+        self._disk_bytes_est: int | None = None
+        self._disk_entries_est: int = 0
         # durable=True fsyncs each record (and its directory entry)
         # before the atomic publish.  The plain mode is already safe
         # against torn FILES (temp + os.replace); durability closes the
@@ -333,6 +352,12 @@ class ResultCache:
         self.evictions = 0
         self.disk_hits = 0
         self.disk_errors = 0
+        # tpusim.guard accounting
+        self.quarantined = 0
+        self.gc_runs = 0
+        self.gc_deleted = 0
+        self.gc_freed_bytes = 0
+        self.lru_shrinks = 0
 
     # -- keys ----------------------------------------------------------------
 
@@ -387,6 +412,20 @@ class ResultCache:
                 self._mem.move_to_end(key)
                 self.hits += 1
         if result is not None:
+            if self.disk_dir is not None and (
+                self.quota_bytes is not None
+                or self.quota_entries is not None
+            ):
+                # under a quota, an in-memory hit is still USAGE of the
+                # durable record: without the touch, a record a hot L1
+                # serves for hours looks oldest-mtime to every peer's
+                # GC and the hottest key dies first (then a watchdog
+                # shrink or worker recycle turns it into a recompute).
+                # Un-governed stores skip the syscall (zero added work).
+                try:
+                    os.utime(self._path_for(key))
+                except OSError:
+                    pass  # evicted by a peer / read-only: plain aging
             self.obs.counter_add("cache.hits")
             return result
         if self.disk_dir is not None:
@@ -437,13 +476,40 @@ class ResultCache:
                     raise ValueError("stored key mismatch (hash collision?)")
                 if doc.get("model_version") != self._model_version:
                     return None  # stale: model bumped under the same name
-                return result_from_doc(doc["result"])
+                result = result_from_doc(doc["result"])
+                try:
+                    # LRU recency lives in the mtime: under a quota, GC
+                    # evicts oldest-mtime first, so a disk hit must
+                    # refresh it — recency is USAGE, not write order
+                    os.utime(path)
+                except OSError:
+                    pass  # read-only store: GC order degrades to FIFO
+                return result
+            except FileNotFoundError:
+                # a peer's GC freed the record between the existence
+                # check and the read (the documented concurrency
+                # contract: deletes are whole-record, so a vanished
+                # file is a plain miss, never damage)
+                return None
             except (ValueError, KeyError, TypeError, OSError) as e:
                 self.disk_errors += 1
                 self.obs.counter_add("cache.disk_errors")
+                # tpusim.guard: quarantine the bad record on FIRST
+                # detection.  Before this, a corrupt record warned and
+                # recomputed on every lookup that raced the healing put
+                # (the driver's parallel pre-scan + the engine's own get
+                # produced two warnings per run; a put that failed left
+                # it warning forever).  Moving the file off the lookup
+                # path makes the recompute heal it permanently: the next
+                # get is a plain miss, and the recompute's put publishes
+                # a fresh record.
+                from tpusim.guard.store import quarantine_record
+
+                if quarantine_record(path):
+                    self.quarantined += 1
                 warnings.warn(
                     f"tpusim.perf: corrupt result-cache entry {path} "
-                    f"({type(e).__name__}: {e}); recomputing",
+                    f"({type(e).__name__}: {e}); quarantined, recomputing",
                     RuntimeWarning,
                     stacklevel=2,
                 )
@@ -471,6 +537,20 @@ class ResultCache:
                     if self.durable:
                         f.flush()
                         os.fsync(f.fileno())
+                governed = (
+                    self.quota_bytes is not None
+                    or self.quota_entries is not None
+                )
+                old_size = 0
+                if governed:
+                    # an overwrite replaces bytes, it doesn't add them:
+                    # the estimate must take the DELTA or re-puts of hot
+                    # keys cross the quota threshold early and trigger
+                    # needless full-directory GC scans
+                    try:
+                        old_size = path.stat().st_size
+                    except OSError:
+                        old_size = 0
                 os.replace(tmp, path)  # atomic: readers never see a torn file
                 if self.durable:
                     # the rename itself must reach disk too, or a crash
@@ -480,6 +560,8 @@ class ResultCache:
                         os.fsync(dir_fd)
                     finally:
                         os.close(dir_fd)
+                if governed:
+                    self._quota_gc(path, old_size)
             except OSError as e:
                 self.disk_errors += 1
                 self.obs.counter_add("cache.disk_errors")
@@ -489,6 +571,100 @@ class ResultCache:
                     RuntimeWarning,
                     stacklevel=2,
                 )
+
+    # -- tpusim.guard: quota GC + memory governance --------------------------
+
+    def _quota_gc(self, new_path: Path, old_size: int = 0) -> None:
+        """Post-publish quota enforcement: account the record just
+        written (as a DELTA against ``old_size``, the bytes the same
+        key held before an overwrite — 0 for a fresh record) and, when
+        the store's estimated size crosses the quota, run the
+        crash-safe LRU GC (:func:`tpusim.guard.store.gc_store` —
+        whole-record deletes by mtime, idempotent under concurrent
+        daemon + N forked workers).  The estimate refreshes to the
+        authoritative scan on every GC, so drift from peers' puts is
+        bounded by one quota excursion."""
+        try:
+            size = new_path.stat().st_size
+        except OSError:
+            size = 0
+        with self._lock:
+            if self._disk_bytes_est is None:
+                total = count = 0
+                for p in self.disk_dir.glob("*.json"):
+                    try:
+                        total += p.stat().st_size
+                        count += 1
+                    except OSError:
+                        pass
+                self._disk_bytes_est = total
+                self._disk_entries_est = count
+            else:
+                self._disk_bytes_est += size - old_size
+                if old_size == 0:
+                    self._disk_entries_est += 1
+            over = (
+                (self.quota_bytes is not None
+                 and self._disk_bytes_est > self.quota_bytes)
+                or (self.quota_entries is not None
+                    and self._disk_entries_est > self.quota_entries)
+            )
+        if not over:
+            return
+        from tpusim.guard.store import gc_store
+
+        res = gc_store(
+            self.disk_dir, quota_bytes=self.quota_bytes,
+            max_entries=self.quota_entries,
+        )
+        with self._lock:
+            self.gc_runs += 1
+            self.gc_deleted += res.deleted
+            self.gc_freed_bytes += res.freed_bytes
+            self._disk_bytes_est = res.remaining_bytes
+            self._disk_entries_est = res.remaining_entries
+
+    def shrink(self, factor: float = 0.5, floor: int = 16) -> int:
+        """Halve (by default) the in-memory LRU's entry budget and trim
+        to it — the memory watchdog's first ladder step.  Cached results
+        re-materialize from the disk tier or a recompute; they are the
+        definition of droppable state.  Returns the entries dropped."""
+        dropped = 0
+        with self._lock:
+            self.max_entries = max(int(self.max_entries * factor), floor)
+            while len(self._mem) > self.max_entries:
+                self._mem.popitem(last=False)
+                self.evictions += 1
+                dropped += 1
+            self.lru_shrinks += 1
+        for _ in range(dropped):
+            self.obs.counter_add("cache.evictions")
+        return dropped
+
+    def restore_entry_budget(self, max_entries: int) -> None:
+        """Reverse :meth:`shrink` — the watchdog's recovery hook.  Only
+        the budget comes back (entries refill on demand); without this,
+        repeated transient excursions would ratchet a long-lived
+        daemon's L1 down to the floor for the rest of its life."""
+        with self._lock:
+            self.max_entries = max(int(max_entries), 1)
+
+    def guard_stats_dict(self) -> dict[str, float]:
+        """Quota/GC accounting, stamped by the driver under the
+        ``guard_`` prefix ONLY when a quota is set (the faults_*
+        discipline: un-governed runs stay key-identical)."""
+        with self._lock:
+            return {
+                "store_quota_bytes": self.quota_bytes or 0,
+                "store_quota_entries": self.quota_entries or 0,
+                "store_bytes_est": self._disk_bytes_est or 0,
+                "store_entries_est": self._disk_entries_est,
+                "store_gc_runs_total": self.gc_runs,
+                "store_gc_deleted_total": self.gc_deleted,
+                "store_gc_freed_bytes_total": self.gc_freed_bytes,
+                "store_quarantined_total": self.quarantined,
+                "lru_shrinks_total": self.lru_shrinks,
+            }
 
     def flush(self) -> int:
         """Ensure every in-memory entry has its disk record (no-op for
@@ -647,6 +823,27 @@ def compiled_for(module, engine):
         while len(_COMPILED) > COMPILED_CACHE_MAX:
             _COMPILED.popitem(last=False)
     return cm
+
+
+def clear_compiled_cache() -> int:
+    """Drop the process-wide compiled-module tier (the memory
+    watchdog's second ladder step).  Compiles are pure functions of
+    content + config, rebuilt on demand — the only cost of clearing is
+    the next pricing call's recompile.  Returns the entries dropped."""
+    with _compiled_lock:
+        n = len(_COMPILED)
+        _COMPILED.clear()
+    return n
+
+
+def set_compiled_cache_max(max_entries: int) -> None:
+    """Bound the compiled-module tier (the ``tpusim.guard`` quota for
+    the in-memory compiled store); trims immediately when lowered."""
+    global COMPILED_CACHE_MAX
+    COMPILED_CACHE_MAX = max(int(max_entries), 1)
+    with _compiled_lock:
+        while len(_COMPILED) > COMPILED_CACHE_MAX:
+            _COMPILED.popitem(last=False)
 
 
 def compiled_cache_stats() -> dict[str, float]:

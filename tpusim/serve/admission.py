@@ -227,11 +227,16 @@ class Job:
     job_id: str
     kind: str
     request: dict
-    status: str = "queued"        # queued | running | done | failed
+    status: str = "queued"   # queued | running | done | failed | cancelled
     result: dict | None = None
     error: str | None = None
     submitted_s: float = field(default_factory=time.monotonic)
     finished_s: float | None = None
+    #: cooperative-cancellation token (tpusim.guard.CancelToken), minted
+    #: at submit so ``DELETE /v1/jobs/<id>`` can trip it whether the job
+    #: is still queued or already running — resumable kinds (campaign)
+    #: check it at scenario grain and journal everything completed
+    cancel_token: object | None = field(default=None, repr=False)
 
     def to_doc(self) -> dict:
         doc = {
@@ -334,6 +339,8 @@ class JobTable:
                     f"{path.name}: {e}",
                     RuntimeWarning, stacklevel=2,
                 )
+        from tpusim.guard.cancel import CancelToken
+
         for doc in recs:
             try:
                 job = Job(
@@ -343,6 +350,7 @@ class JobTable:
                     status=str(doc.get("status", "queued")),
                     result=doc.get("result"),
                     error=doc.get("error"),
+                    cancel_token=CancelToken(),
                 )
                 num = int(job.job_id.rsplit("-", 1)[1])
             except (KeyError, TypeError, ValueError, IndexError):
@@ -364,9 +372,11 @@ class JobTable:
             if len(self._queue) >= self.queue_depth:
                 raise Overloaded(float(len(self._queue)))
             self._next_id += 1
+            from tpusim.guard.cancel import CancelToken
+
             job = Job(
                 job_id=f"job-{self._next_id:06d}", kind=kind,
-                request=request,
+                request=request, cancel_token=CancelToken(),
             )
             self._queue.append(job)
             self._jobs[job.job_id] = job
@@ -400,14 +410,54 @@ class JobTable:
             self._persist(job)
             return job
 
-    def finish(self, job: Job, result: dict | None, error: str | None) -> None:
+    def finish(
+        self, job: Job, result: dict | None, error: str | None,
+        status: str | None = None,
+    ) -> None:
+        """Land a terminal state.  ``status`` overrides the derived
+        done/failed verdict — the job loop passes ``"cancelled"`` when a
+        run raised :class:`tpusim.guard.OperationCancelled` (a client
+        asked for it; not a failure, not a success)."""
         with self._cond:
-            job.status = "failed" if error is not None else "done"
+            job.status = status or (
+                "failed" if error is not None else "done"
+            )
             job.result = result
             job.error = error
             job.finished_s = time.monotonic()
             self._persist(job)
             self._cond.notify_all()
+
+    def cancel(self, job_id: str) -> str | None:
+        """``DELETE /v1/jobs/<id>``: a queued job lands terminal
+        ``cancelled`` immediately; a running job has its token tripped
+        and the job loop records ``cancelled`` when the runner unwinds
+        (campaign journals guarantee a later resume re-prices nothing
+        completed).  Returns the job's (possibly new) status, or None
+        for an unknown id.  Terminal jobs are a no-op — cancelling what
+        already finished changes nothing."""
+        with self._cond:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            if job.status == "queued":
+                try:
+                    self._queue.remove(job)
+                except ValueError:
+                    pass  # a worker popped it between our checks
+                else:
+                    job.status = "cancelled"
+                    job.error = "cancelled by client"
+                    job.finished_s = time.monotonic()
+                    self._persist(job)
+                    self._cond.notify_all()
+                    return job.status
+            if job.status == "running":
+                tok = job.cancel_token
+                if tok is not None:
+                    tok.cancel("cancelled by client (DELETE /v1/jobs)")
+                return "cancelling"
+            return job.status
 
     def start_drain(self) -> None:
         with self._cond:
@@ -437,7 +487,7 @@ class JobTable:
         # caller runs evict_hook on them OUTSIDE the lock.
         terminal = [
             jid for jid, j in self._jobs.items()
-            if j.status in ("done", "failed")
+            if j.status in ("done", "failed", "cancelled")
         ]
         evicted: list[str] = []
         while len(terminal) > self.keep:
@@ -449,7 +499,8 @@ class JobTable:
 
     def stats_dict(self) -> dict[str, float]:
         with self._cond:
-            counts = {"queued": 0, "running": 0, "done": 0, "failed": 0}
+            counts = {"queued": 0, "running": 0, "done": 0, "failed": 0,
+                      "cancelled": 0}
             for j in self._jobs.values():
                 counts[j.status] = counts.get(j.status, 0) + 1
             return {f"jobs_{k}": v for k, v in counts.items()}

@@ -95,14 +95,14 @@ class JobStatus:
     """``GET /v1/jobs/<id>`` response."""
 
     job_id: str
-    status: str        # queued | running | done | failed
+    status: str        # queued | running | done | failed | cancelled
     result: dict | None = None
     error: str | None = None
     raw: dict = field(default_factory=dict)
 
     @property
     def terminal(self) -> bool:
-        return self.status in ("done", "failed")
+        return self.status in ("done", "failed", "cancelled")
 
 
 #: per-process client counter — the instance half of the jitter salt
@@ -382,6 +382,20 @@ class ServeClient:
             error=doc.get("error"),
             raw=doc,
         )
+
+    def cancel_job(
+        self, job_id: str, timeout_s: float | None = None,
+    ) -> str:
+        """``DELETE /v1/jobs/<id>`` — cooperative cancellation.  A
+        queued job is terminal ``cancelled`` on return; a running
+        campaign/advise job returns ``cancelling`` and lands terminal
+        once the runner unwinds at its next scenario/cell boundary
+        (poll with :meth:`wait_job`; completed scenarios stay journaled
+        for ``--resume``).  Returns the job's reported status."""
+        doc = self._request(
+            "DELETE", f"/v1/jobs/{job_id}", timeout_s=timeout_s,
+        )
+        return str(doc["status"])
 
     def wait_job(
         self, job_id: str, timeout_s: float = 120.0,

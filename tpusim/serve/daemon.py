@@ -18,6 +18,11 @@ route                 verb  backing layer
 ``/v1/advise``        POST  :class:`JobTable` (async; the sharding
                             advisor's ranked strategy-sweep report)
 ``/v1/jobs/<id>``     GET   :class:`JobTable`
+``/v1/jobs/<id>``     DEL   :class:`JobTable` (cooperative cancel —
+                            queued jobs land ``cancelled`` at once,
+                            running campaign/advise jobs unwind at
+                            their next scenario/cell boundary with
+                            completed work journaled)
 ``/v1/traces``        GET   :class:`TraceRegistry`
 ``/healthz``          GET   liveness (503 while draining)
 ``/metrics``          GET   Prometheus via ``obs.export.prometheus_text``
@@ -45,6 +50,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from tpusim.guard.cancel import CancelToken, OperationCancelled
 from tpusim.serve.admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -54,7 +60,11 @@ from tpusim.serve.admission import (
     Overloaded,
 )
 from tpusim.serve.registry import TraceRegistry
-from tpusim.serve.supervisor import Supervisor, WorkerTimeout
+from tpusim.serve.supervisor import (
+    CooperativeCancel,
+    Supervisor,
+    WorkerTimeout,
+)
 from tpusim.serve.worker import MAX_DEADLINE_S, RequestError, ServeWorker
 
 __all__ = ["SERVE_FORMAT_VERSION", "ServeDaemon"]
@@ -265,6 +275,33 @@ class _Handler(BaseHTTPRequestHandler):
                 "error": "unknown_route", "detail": f"no route {path!r}",
             })
 
+    def do_DELETE(self) -> None:  # noqa: N802 - stdlib signature
+        """``DELETE /v1/jobs/<id>`` — cooperative job cancellation
+        (tpusim.guard): a queued job lands terminal ``cancelled``
+        immediately; a running campaign/advise job has its token
+        tripped and unwinds at its next scenario/cell boundary with
+        everything completed already journaled (a later ``--resume``
+        re-prices nothing)."""
+        d = self.daemon_obj
+        d._count("serve_requests_total")
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if not path.startswith("/v1/jobs/"):
+            self._send_json(404, {
+                "error": "unknown_route", "detail": f"no route {path!r}",
+            })
+            return
+        job_id = path.rsplit("/", 1)[1]
+        status = d.jobs.cancel(job_id)
+        if status is None:
+            self._send_json(404, {
+                "error": "unknown_job",
+                "detail": f"no such job {job_id!r}",
+            })
+            return
+        if status in ("cancelled", "cancelling"):
+            d._count("serve_jobs_cancel_requests_total")
+        self._send_json(200, {"job_id": job_id, "status": status})
+
     def _run_sync(self, endpoint: str, fn) -> None:
         """Admission-gated execution of one synchronous endpoint."""
         d = self.daemon_obj
@@ -283,6 +320,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         budget_s = min(max(budget_s, 0.0), MAX_DEADLINE_S)
         deadline = time.monotonic() + budget_s
+        if d.watchdog is not None and d.watchdog.shedding:
+            # the memory ladder's terminal step: past the hard RSS
+            # threshold with every droppable store already dropped,
+            # admitting more work would invite the OOM-killer — shed
+            # with a hint sized to the sampler's recovery cadence
+            d._count("guard_shed_503_total")
+            self._send_json(503, {
+                "error": "memory_pressure",
+                "detail": (
+                    "daemon is over its --max-rss hard threshold and "
+                    "shedding load; retry shortly"
+                ),
+            }, headers={"Retry-After": 2})
+            return
         try:
             with d.admission.admit(deadline):
                 if d.work_hook is not None:
@@ -321,6 +372,23 @@ class _Handler(BaseHTTPRequestHandler):
                     "the restart backoff"
                 ),
             }, headers={"Retry-After": int(e.retry_after_s)})
+            return
+        except (CooperativeCancel, OperationCancelled):
+            # tpusim.guard: the deadline tripped INSIDE the pricing
+            # stack and the run cancelled in-process — still a 504, but
+            # the worker (process or thread) survives with its caches
+            # warm and zero restarts.  Ordered before WorkerTimeout/
+            # DeadlineExceeded: CooperativeCancel subclasses them.
+            d._count("serve_deadline_504_total")
+            d._count("guard_coop_504_total")
+            self._send_json(504, {
+                "error": "deadline_exceeded",
+                "detail": (
+                    f"pricing exceeded the {budget_s:.3f}s deadline and "
+                    f"was cancelled in-process (cooperative cancel); "
+                    f"the worker survives with warm caches"
+                ),
+            })
             return
         except WorkerTimeout:
             # ordered before DeadlineExceeded (its parent): the request
@@ -389,9 +457,13 @@ class ServeDaemon:
         state_dir=None,
         verbose: bool = False,
         work_hook=None,
+        cache_quota=None,
+        max_rss=None,
+        max_worker_rss=None,
     ):
         from pathlib import Path
 
+        from tpusim.guard.store import parse_size
         from tpusim.perf.cache import ResultCache, as_result_cache
 
         self.host = host
@@ -411,6 +483,12 @@ class ServeDaemon:
         self.result_cache.max_entries = max(
             self.result_cache.max_entries, int(cache_entries)
         )
+        # tpusim.guard: --cache-quota bounds the shared disk tier.  The
+        # daemon's own publishes GC it; worker fleets get the same quota
+        # via settings so every writer of the dir enforces it.
+        self.cache_quota_bytes = parse_size(cache_quota)
+        if self.cache_quota_bytes is not None:
+            self.result_cache.quota_bytes = self.cache_quota_bytes
         self.registry = TraceRegistry(trace_root)
         self.worker = ServeWorker(
             self.registry, result_cache=self.result_cache, workers=workers,
@@ -432,6 +510,7 @@ class ServeDaemon:
                         if self.result_cache.disk_dir else None
                     ),
                     "cache_entries": int(cache_entries),
+                    "cache_quota_bytes": self.cache_quota_bytes,
                     "chaos_hooks": bool(chaos_hooks),
                     # lets workers serialize the FINAL response body
                     # (byte-identical to _send_json's by construction)
@@ -440,6 +519,7 @@ class ServeDaemon:
                 num_workers=self.serve_workers,
                 min_live=min_workers,
                 restart_backoff_s=restart_backoff_s,
+                max_worker_rss_bytes=parse_size(max_worker_rss),
             )
             if self.result_cache.disk_dir is not None:
                 # the parent still publishes to the shared dir (async
@@ -468,6 +548,25 @@ class ServeDaemon:
             # monotonically with every campaign ever run
             evict_hook=self._evict_job_state,
         )
+
+        # tpusim.guard: --max-rss mounts the memory watchdog with the
+        # documented degradation ladder (shrink LRUs → drop compiled
+        # tier → force lean streaming); its terminal shed state makes
+        # _run_sync answer 503 + Retry-After instead of letting the
+        # OOM-killer choose a victim
+        self.watchdog = None
+        max_rss_bytes = parse_size(max_rss)
+        if max_rss_bytes is not None:
+            from tpusim.guard.watchdog import MemoryWatchdog, default_ladder
+
+            self.watchdog = default_ladder(
+                MemoryWatchdog(
+                    soft_bytes=None, hard_bytes=max_rss_bytes,
+                ),
+                result_cache=self.result_cache,
+            )
+        #: startup integrity-sweep counters (guard_* /metrics gauges)
+        self._guard_startup: dict[str, float] = {}
 
         self._httpd: ThreadingHTTPServer | None = None
         self._serve_thread: threading.Thread | None = None
@@ -516,6 +615,20 @@ class ServeDaemon:
         if self.supervisor is not None:
             for k, v in self.supervisor.stats_dict().items():
                 values[f"serve_{k}"] = v
+        # tpusim.guard gauges — only when guard features are active
+        # (quota / watchdog / startup sweep), mirroring the report-key
+        # discipline: an un-governed daemon's scrape is unchanged
+        if (
+            self.result_cache.quota_bytes is not None
+            or self.result_cache.quota_entries is not None
+        ):
+            for k, v in self.result_cache.guard_stats_dict().items():
+                values[f"guard_{k}"] = v
+        if self.watchdog is not None:
+            for k, v in self.watchdog.stats_dict().items():
+                values[f"guard_{k}"] = v
+        for k, v in self._guard_startup.items():
+            values[f"guard_{k}"] = v
         return prometheus_text(
             values,
             help_text={
@@ -528,13 +641,14 @@ class ServeDaemon:
 
     def execute_sync(self, endpoint: str, fn, body: dict, deadline: float):
         """One admitted synchronous request: through the supervised
-        worker pool when mounted (crash isolation, deadline kills,
-        quarantine — the serve v2 path), else the in-process worker
-        (``fn``), which is the PR 5 single-process contract.  Responses
-        are byte-identical either way."""
+        worker pool when mounted (crash isolation, cooperative deadline
+        cancel with kill escalation, quarantine — the serve v2 path),
+        else the in-process worker (``fn``) pricing under a
+        :class:`~tpusim.guard.CancelToken` armed with the same deadline.
+        Responses are byte-identical either way."""
         if self.supervisor is not None:
             return self.supervisor.execute(endpoint, body, deadline=deadline)
-        return fn(body)
+        return fn(body, cancel=CancelToken(deadline=deadline))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -551,6 +665,34 @@ class ServeDaemon:
     def start(self) -> "ServeDaemon":
         """Bind the listener and start serving on background threads.
         Returns self (so tests can ``ServeDaemon(...).start()``)."""
+        if self.result_cache.disk_dir is not None \
+                and self.result_cache.disk_dir.is_dir():
+            # startup integrity sweep (tpusim.guard): quarantine corrupt
+            # or stale-format records BEFORE the first request can trip
+            # over them — a crashed peer's damage heals at boot, not one
+            # warning at a time under traffic
+            from tpusim.guard.store import verify_store
+
+            res = verify_store(self.result_cache.disk_dir)
+            self._guard_startup = {
+                "startup_records_checked": res.checked,
+                "startup_records_ok": res.ok,
+                "startup_quarantined": (
+                    res.quarantined_corrupt + res.quarantined_stale_format
+                ),
+                "startup_stale_model": res.stale_model,
+            }
+            if self.verbose and (
+                res.quarantined_corrupt or res.quarantined_stale_format
+            ):
+                print(
+                    f"tpusim serve: startup sweep quarantined "
+                    f"{res.quarantined_corrupt} corrupt + "
+                    f"{res.quarantined_stale_format} stale-format "
+                    f"cache record(s)"
+                )
+        if self.watchdog is not None:
+            self.watchdog.start()
         handler = type(
             "BoundHandler", (_Handler,), {"daemon_obj": self},
         )
@@ -606,13 +748,14 @@ class ServeDaemon:
         if job.kind == "campaign":
             return self.worker.campaign(
                 job.request, out_dir=self.campaign_dir(job.job_id),
+                cancel=job.cancel_token,
             )
         if job.kind == "advise":
             # no journal: an advise sweep is cache-warm cheap, so a
             # recovered job simply re-prices (byte-identical by the
             # determinism contract)
-            return self.worker.advise(job.request)
-        return self.worker.sweep(job.request)
+            return self.worker.advise(job.request, cancel=job.cancel_token)
+        return self.worker.sweep(job.request, cancel=job.cancel_token)
 
     def _job_loop(self) -> None:
         while True:
@@ -623,6 +766,14 @@ class ServeDaemon:
                 continue
             try:
                 result = self._run_job(job)
+            except OperationCancelled as e:
+                # DELETE /v1/jobs/<id> landed mid-run: the runner
+                # unwound at a scenario/cell boundary with completed
+                # work journaled — terminal 'cancelled', not 'failed'
+                self.jobs.finish(
+                    job, None, f"cancelled: {e}", status="cancelled",
+                )
+                self._count("serve_jobs_cancelled_total")
             except RequestError as e:
                 self.jobs.finish(job, None, f"{e.code}: {e.detail}")
                 self._count("serve_jobs_failed_total")
@@ -644,6 +795,8 @@ class ServeDaemon:
         self._stop_jobs.set()
         for t in self._job_threads:
             t.join(timeout=2.0)
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         flushed = self.result_cache.flush()
@@ -663,6 +816,8 @@ class ServeDaemon:
         self._stop_jobs.set()
         for t in self._job_threads:
             t.join(timeout=2.0)
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.supervisor is not None:
             # crash simulation still reaps the fleet: orphan workers
             # would hold the (inherited) state the next daemon needs

@@ -61,12 +61,13 @@ import time
 from tpusim.serve.admission import Degraded, DeadlineExceeded
 from tpusim.serve.worker import RequestError, worker_child_main
 
-__all__ = ["Supervisor", "WorkerSlot", "WorkerTimeout"]
+__all__ = ["CooperativeCancel", "Supervisor", "WorkerSlot", "WorkerTimeout"]
 
 #: fields stripped from the affinity/quarantine hash: they change how
 #: long a request may run, never what it prices (a poison request with a
-#: different deadline is the same poison)
-_VOLATILE_BODY_KEYS = ("deadline_ms",)
+#: different deadline is the same poison; ``_budget_s`` is the shipped
+#: remaining-deadline budget of the cooperative-cancellation frame)
+_VOLATILE_BODY_KEYS = ("deadline_ms", "_budget_s")
 
 #: restart backoff ceiling — a flapping worker must not sleep forever
 MAX_RESTART_BACKOFF_S = 30.0
@@ -74,11 +75,25 @@ MAX_RESTART_BACKOFF_S = 30.0
 #: grace between SIGTERM and the SIGKILL escalation on a deadline kill
 KILL_GRACE_S = 0.5
 
+#: grace PAST the request deadline for the worker's cooperative
+#: cancellation frame (tpusim.guard): the child's own CancelToken trips
+#: at the same instant, and letting it unwind in-process keeps the
+#: worker — and its warm registry/L1 — alive.  Only a worker that never
+#: reaches a cancel check inside this window (a hung native call, a
+#: chaos sleep) meets the SIGTERM/SIGKILL escalation.
+COOP_CANCEL_GRACE_S = 0.75
+
 
 class WorkerTimeout(DeadlineExceeded):
     """The request's deadline expired while a worker was pricing it; the
     worker was killed and is being restarted.  Subclasses
     :class:`DeadlineExceeded` so the HTTP layer's 504 mapping applies."""
+
+
+class CooperativeCancel(DeadlineExceeded):
+    """The request's deadline expired and the worker cancelled it
+    IN-PROCESS (tpusim.guard): still a 504, but the worker survives
+    with its caches warm and the restart counters untouched."""
 
 
 class _WorkerGone(ConnectionError):
@@ -156,8 +171,16 @@ class Supervisor:
         quarantine_max: int = 256,
         restart_backoff_s: float = 0.05,
         spawn_timeout_s: float = 60.0,
+        max_worker_rss_bytes: int | None = None,
     ):
         self.settings = dict(settings)
+        # tpusim.guard: per-worker RSS cap.  The monitor samples each
+        # idle worker's /proc RSS about once a second and restarts an
+        # over-budget one DELIBERATELY between requests (commanded kill,
+        # base restart delay) — the OOM-killer stops choosing victims.
+        self.max_worker_rss_bytes = (
+            int(max_worker_rss_bytes) if max_worker_rss_bytes else None
+        )
         self.num_workers = max(int(num_workers), 1)
         self.min_live = min(max(int(min_live), 1), self.num_workers)
         self.retry_budget = max(int(retry_budget), 0)
@@ -182,6 +205,9 @@ class Supervisor:
         self.retried = 0
         self.shed = 0
         self.poisoned = 0
+        self.coop_cancels = 0
+        self.rss_kills = 0
+        self._rss_tick = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -355,10 +381,15 @@ class Supervisor:
         base = min(base, MAX_RESTART_BACKOFF_S)
         return base + _det_jitter(slot.index, slot.spawns, base)
 
-    def _mark_dead(self, slot: WorkerSlot, *, commanded: bool) -> None:
+    def _mark_dead(
+        self, slot: WorkerSlot, *, commanded: bool, count_kill: bool = True,
+    ) -> None:
         """Record a worker death and schedule its restart.  Commanded
         kills (deadline enforcement) restart on the base delay — the
-        request was at fault; uncommanded crashes compound the backoff."""
+        request was at fault; uncommanded crashes compound the backoff.
+        ``count_kill=False`` is the deliberate RSS recycle: commanded
+        semantics (base delay, no crash streak) without inflating the
+        deadline-kill counter."""
         with self._lock:
             was_alive = slot.alive
             slot.alive = False
@@ -366,7 +397,8 @@ class Supervisor:
             if not was_alive:
                 return
             if commanded:
-                slot.kills += 1
+                if count_kill:
+                    slot.kills += 1
                 slot.next_restart_at = (
                     time.monotonic() + self.restart_backoff_s
                 )
@@ -394,13 +426,13 @@ class Supervisor:
             # forever for capacity that just died)
             self._free_cond.notify_all()
 
-    def _kill_slot(self, slot: WorkerSlot) -> None:
+    def _kill_slot(self, slot: WorkerSlot, count_kill: bool = True) -> None:
         """Deadline enforcement: SIGTERM, a short grace, then SIGKILL.
         A worker stuck in a native call ignores the TERM; the KILL does
         not ask."""
         proc = slot.proc
         if proc is None or proc.pid is None:
-            self._mark_dead(slot, commanded=True)
+            self._mark_dead(slot, commanded=True, count_kill=count_kill)
             return
         try:
             proc.terminate()
@@ -410,12 +442,19 @@ class Supervisor:
                 proc.join(2.0)
         except (OSError, ValueError):
             pass
-        self._mark_dead(slot, commanded=True)
+        self._mark_dead(slot, commanded=True, count_kill=count_kill)
 
     def _monitor_loop(self) -> None:
-        """Detect idle deaths (a worker OOM-killed between requests) and
-        restart dead slots once their backoff gate opens."""
+        """Detect idle deaths (a worker OOM-killed between requests),
+        restart dead slots once their backoff gate opens, and enforce
+        the per-worker RSS cap (tpusim.guard)."""
         while not self._stop.wait(0.05):
+            self._rss_tick += 1
+            if (
+                self.max_worker_rss_bytes is not None
+                and self._rss_tick % 20 == 0  # ~1s cadence
+            ):
+                self._enforce_rss_caps()
             for slot in self.slots:
                 if self._stop.is_set():
                     return
@@ -449,6 +488,30 @@ class Supervisor:
                         name=f"tpusim-serve-respawn-{slot.index}",
                         daemon=True,
                     ).start()
+
+    def _enforce_rss_caps(self) -> None:
+        """Restart any IDLE worker whose RSS exceeds the cap — a
+        deliberate, supervised recycle (base restart delay, fresh
+        caches) instead of the OOM-killer picking a victim mid-request.
+        Busy slots are skipped: the cap never truncates in-flight work;
+        a worker that stays busy is bounded by the request deadline."""
+        from tpusim.guard.watchdog import rss_bytes
+
+        for slot in self.slots:
+            if not slot.alive or slot.pid is None:
+                continue
+            rss = rss_bytes(slot.pid)
+            if rss <= 0 or rss < self.max_worker_rss_bytes:
+                continue
+            if not slot.lock.acquire(blocking=False):
+                continue  # busy: re-checked next sweep
+            try:
+                if slot.alive and slot.pid is not None:
+                    with self._lock:
+                        self.rss_kills += 1
+                    self._kill_slot(slot, count_kill=False)
+            finally:
+                slot.lock.release()
 
     def _respawn_locked(self, slot: WorkerSlot) -> None:
         """Monitor handed us ``slot.lock`` already held; boot the
@@ -541,22 +604,35 @@ class Supervisor:
             req_id = self._req_seq
         conn = slot.conn
         acked = False
+        if deadline is not None:
+            # ship the remaining budget so the child arms its own
+            # CancelToken (tokens never cross pipes); the signal kill
+            # below becomes the ESCALATION past the cooperative grace,
+            # not the first resort
+            body = dict(body or {})
+            body["_budget_s"] = max(deadline - time.monotonic(), 0.0)
         try:
             conn.send((req_id, endpoint, body))
         except (BrokenPipeError, OSError):
             self._mark_dead(slot, commanded=False)
             raise _WorkerGone("worker died before the request was sent")
+        kill_at = (
+            deadline + COOP_CANCEL_GRACE_S if deadline is not None
+            else None
+        )
         while True:
             timeout = 0.5
             if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
+                now = time.monotonic()
+                if now >= kill_at:
                     self._kill_slot(slot)
                     raise WorkerTimeout(
                         "worker exceeded the request deadline and was "
                         "killed"
                     )
-                timeout = min(timeout, remaining)
+                # past the deadline but inside the grace: keep polling
+                # for the worker's in-process 'cancelled' frame
+                timeout = min(timeout, max(kill_at - now, 0.01))
             try:
                 if conn.poll(timeout):
                     msg = conn.recv()
@@ -670,6 +746,13 @@ class Supervisor:
                 # ok_bytes is the final serialized response body (the
                 # worker's serialization IS the parent's, byte for byte)
                 return payload
+            if kind == "cancelled":
+                # the worker cancelled in-process at its deadline and
+                # SURVIVED (slot released live above, caches warm, no
+                # restart) — SIGKILL never entered the picture
+                with self._lock:
+                    self.coop_cancels += 1
+                raise CooperativeCancel(str(payload))
             if kind == "request_error":
                 status, code, detail, extra = payload
                 raise RequestError(status, code, detail, extra)
@@ -727,4 +810,8 @@ class Supervisor:
             "quarantine_size": len(self._quarantine),
             "poison_422_total": self.poisoned,
             "shed_503_total": self.shed,
+            # tpusim.guard: in-process deadline cancels (worker
+            # survived) and deliberate per-worker RSS recycles
+            "worker_coop_cancels_total": self.coop_cancels,
+            "worker_rss_kills_total": self.rss_kills,
         }

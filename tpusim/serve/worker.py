@@ -241,8 +241,13 @@ class ServeWorker:
 
     # -- endpoints -----------------------------------------------------------
 
-    def simulate(self, req: dict) -> dict:
-        """``POST /v1/simulate`` — price one pod replay."""
+    def simulate(self, req: dict, cancel=None) -> dict:
+        """``POST /v1/simulate`` — price one pod replay.  ``cancel``
+        (a :class:`tpusim.guard.CancelToken` armed with the request's
+        deadline) makes the replay cooperatively cancellable: the
+        driver raises :class:`tpusim.guard.OperationCancelled` at the
+        next command/op boundary, the HTTP layer answers 504, and this
+        worker — process or thread — survives with every cache warm."""
         from tpusim.sim.driver import SimDriver
 
         entry, inline = self._resolve_entry(req)
@@ -270,7 +275,7 @@ class ServeWorker:
         try:
             report = SimDriver(
                 cfg, faults=faults, result_cache=view,
-                workers=self.workers,
+                workers=self.workers, cancel=cancel,
             ).run(entry.pod)
         except (ValueError, KeyError, TopologyPartitionedError) as e:
             # a replay refusal (partitioned topology, unknown module) is
@@ -290,9 +295,11 @@ class ServeWorker:
             "stats": stats,
         }
 
-    def lint(self, req: dict) -> dict:
+    def lint(self, req: dict, cancel=None) -> dict:
         """``POST /v1/lint`` — the analyzer's report, never a refusal
-        (lint findings are the payload, not an error)."""
+        (lint findings are the payload, not an error).  ``cancel`` is
+        accepted for endpoint-signature uniformity; analysis runs in
+        milliseconds, below any useful cancellation grain."""
         entry, inline = self._resolve_entry(req)
         cfg = self._config_for(entry.pod, req)
         diags = self._analyze(entry, inline, cfg, req)
@@ -308,9 +315,11 @@ class ServeWorker:
             "diagnostics": json.loads(diags.to_json()),
         }
 
-    def sweep(self, req: dict) -> dict:
+    def sweep(self, req: dict, cancel=None) -> dict:
         """``POST /v1/sweep`` body → the sweep report (runs on a job
-        thread; the HTTP layer returns a job id immediately)."""
+        thread; the HTTP layer returns a job id immediately).
+        ``cancel`` is the job's token — ``DELETE /v1/jobs/<id>`` trips
+        it and the sweep unwinds at link grain as ``cancelled``."""
         from tpusim.faults.sweep import single_link_sweep, trace_step_sweep
         from tpusim.ici.topology import torus_for
 
@@ -328,6 +337,7 @@ class ServeWorker:
                 result_cache=self.result_cache,
                 pod=entry.pod,
                 config=cfg,
+                cancel=cancel,
             )
         else:
             cfg = self._config_for_sweep(req)
@@ -339,10 +349,11 @@ class ServeWorker:
                 payload_bytes=payload_mb * 1024 * 1024,
                 kind=str(req.get("kind", "all-reduce")),
                 workers=self.workers,
+                cancel=cancel,
             )
         return result.to_doc()
 
-    def campaign(self, req: dict, out_dir=None) -> dict:
+    def campaign(self, req: dict, out_dir=None, cancel=None) -> dict:
         """``POST /v1/campaign`` body → the campaign report (runs on a
         job thread).  ``req['spec']`` is the campaign spec document;
         the workload is the usual ``trace``/``hlo_text`` pair.  With a
@@ -380,6 +391,7 @@ class ServeWorker:
                 resume=out_dir is not None,
                 result_cache=self.result_cache,
                 workers=self.workers,
+                cancel=cancel,
             )
         except ValidationError as e:
             raise RequestError(
@@ -393,7 +405,7 @@ class ServeWorker:
         self._accumulate(result.stats.stats_dict())
         return result.doc
 
-    def advise(self, req: dict) -> dict:
+    def advise(self, req: dict, cancel=None) -> dict:
         """``POST /v1/advise`` body → the ranked advisor report (runs
         on a job thread).  ``req['spec']`` is the advise spec document;
         the workload is the usual ``trace``/``hlo_text`` pair.  The
@@ -427,6 +439,7 @@ class ServeWorker:
                 trace_name=entry.name,
                 result_cache=self.result_cache,
                 workers=self.workers,
+                cancel=cancel,
             )
         except ValidationError as e:
             raise RequestError(
@@ -509,10 +522,21 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
     warn about).  Nothing here is shared mutable state with the parent,
     so a SIGKILL at any instant costs exactly this process.
 
+    Cooperative cancellation (tpusim.guard): the supervisor ships the
+    request's remaining deadline budget as the volatile body key
+    ``_budget_s``; the child builds its own
+    :class:`~tpusim.guard.CancelToken` from it (tokens never travel
+    across pipes) and prices under it.  A tripped token unwinds as the
+    ``cancelled`` frame — the worker stays alive with its registry and
+    L1 warm, the parent answers 504, and SIGTERM/SIGKILL becomes the
+    escalation for a worker that never reaches a check (a hung native
+    call), not the first resort.
+
     ``settings["chaos_hooks"]`` arms the fault-injection hooks the chaos
     tests and the CI chaos smoke use (``_chaos_exit`` → ``os._exit``,
-    ``_chaos_sleep_s`` → sleep before pricing); a production daemon
-    never sets it.
+    ``_chaos_sleep_s`` → sleep before pricing, ``_chaos_spin_s`` → a
+    cancel-aware busy loop standing in for long pricing); a production
+    daemon never sets it.
     """
     import os
     import signal as _signal
@@ -532,6 +556,7 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
         except (OSError, ValueError, TypeError):
             pass
 
+    from tpusim.guard.cancel import OperationCancelled
     from tpusim.perf.cache import ResultCache
     from tpusim.serve.registry import TraceRegistry
 
@@ -541,6 +566,10 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
         disk_dir=disk_dir,
         max_entries=int(settings.get("cache_entries", 4096) or 4096),
         durable=disk_dir is not None,
+        # the daemon's --cache-quota governs every writer of the shared
+        # dir: each worker enforces it on its own puts (gc_store deletes
+        # are idempotent across the fleet by design)
+        quota_bytes=settings.get("cache_quota_bytes"),
     )
     worker = ServeWorker(registry, result_cache=cache, workers=1)
     chaos = bool(settings.get("chaos_hooks"))
@@ -568,6 +597,12 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
             conn.send((req_id, "ack", None))
         except (BrokenPipeError, OSError):
             return
+        cancel = None
+        if isinstance(body, dict) and body.get("_budget_s") is not None:
+            from tpusim.guard.cancel import CancelToken
+
+            body = dict(body)
+            cancel = CancelToken.after(float(body.pop("_budget_s")))
         if chaos and isinstance(body, dict):
             if body.get("_chaos_exit"):
                 os._exit(3)
@@ -575,16 +610,32 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
             if nap:
                 _time.sleep(min(float(nap), 30.0))
         try:
+            if chaos and isinstance(body, dict) and body.get("_chaos_spin_s"):
+                # a cancel-aware stand-in for long pricing: spins like a
+                # big replay would, checking the token at its grain —
+                # the deterministic vehicle for the coop-cancel smoke
+                spin_until = _time.monotonic() + min(
+                    float(body["_chaos_spin_s"]), 30.0
+                )
+                while _time.monotonic() < spin_until:
+                    if cancel is not None:
+                        cancel.check()
+                    _time.sleep(0.005)
             if endpoint not in _CHILD_ENDPOINTS:
                 raise RequestError(
                     404, "unknown_endpoint",
                     f"supervised workers serve {sorted(_CHILD_ENDPOINTS)},"
                     f" not {endpoint!r}",
                 )
-            result = getattr(worker, endpoint)(body)
+            result = getattr(worker, endpoint)(body, cancel=cancel)
         except RequestError as e:
             out = (req_id, "request_error",
                    (e.status, e.code, e.detail, e.extra))
+        except OperationCancelled as e:
+            # the deadline tripped INSIDE the pricing stack: this
+            # process is healthy, its caches warm — the supervisor
+            # answers 504 without killing anything
+            out = (req_id, "cancelled", str(e))
         except Exception as e:  # noqa: BLE001 - the worker's 500 boundary
             out = (req_id, "error", f"{type(e).__name__}: {e}")
         else:

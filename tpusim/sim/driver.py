@@ -147,10 +147,18 @@ class SimDriver:
         result_cache=None,
         workers: int | None = None,
         pricing_backend: str | None = None,
+        cancel=None,
     ):
         self.config = config
         self.arch = config.arch
         self.topology = topology
+        # cooperative cancellation (tpusim.guard.CancelToken | None):
+        # checked at command grain in the stream walk below and threaded
+        # into every engine (serial walk stride + fastpath blocks).  A
+        # tripped token raises OperationCancelled with every cache entry
+        # already published — the serve tier maps it to a 504 with the
+        # worker's caches warm, the CLI to a clean refusal.
+        self.cancel = cancel
         # instrumentation hub (tpusim.obs); the no-op default adds no
         # stats keys and no per-command work
         self.obs = obs if obs is not None else NULL_OBS
@@ -180,6 +188,7 @@ class SimDriver:
         t_start = time.perf_counter()
         cfg = self.config
         arch = self.arch
+        cancel = self.cancel
 
         n_devices = max(
             (int(pod.meta.get("num_devices", 0) or 0)),
@@ -218,13 +227,15 @@ class SimDriver:
                 return CachedEngine(
                     cfg, topology=topo, obs=obs,
                     result_cache=self.result_cache,
-                    pricing_backend=self.pricing_backend, **kw,
+                    pricing_backend=self.pricing_backend,
+                    cancel=self.cancel, **kw,
                 )
         else:
             def _new_engine(**kw) -> Engine:
                 return Engine(
                     cfg, topology=topo, obs=obs,
-                    pricing_backend=self.pricing_backend, **kw,
+                    pricing_backend=self.pricing_backend,
+                    cancel=self.cancel, **kw,
                 )
 
         engine = _new_engine()
@@ -373,6 +384,11 @@ class SimDriver:
                 else:
                     remaining.append(mkey)
             if len(remaining) > 1:
+                if cancel is not None:
+                    # last check before forking: pool workers run their
+                    # segment to completion (tokens are process-local);
+                    # the parent re-checks at every command below
+                    cancel.check()
                 priced = map_ordered(
                     _price_segment_worker, remaining, workers=workers,
                     context=(cfg, topo, pod.modules, self.result_cache,
@@ -413,6 +429,11 @@ class SimDriver:
             # far-ahead DMA/collective prefetch is bounded
             kernel_ends: list[float] = []
             for cmd in dev.commands:
+                # the driver's cancellation grain: a fault window cannot
+                # split a command, and neither can a cancel — the whole
+                # launch prices or the walk raises before it starts
+                if cancel is not None:
+                    cancel.check()
                 key = (dev_id, cmd.stream_id)
                 ready = stream_free[key]
                 if len(kernel_ends) >= window:
@@ -626,6 +647,16 @@ class SimDriver:
             report.stats.update(
                 self.result_cache.stats_dict(), prefix="cache_"
             )
+            if (
+                self.result_cache.quota_bytes is not None
+                or self.result_cache.quota_entries is not None
+            ):
+                # guard_* keys ride the report ONLY when a store quota
+                # is actually governing (the faults_* discipline:
+                # un-governed runs stay key-identical, goldens pinned)
+                report.stats.update(
+                    self.result_cache.guard_stats_dict(), prefix="guard_"
+                )
         if pool_segments:
             report.stats.update(
                 {"workers": workers, "parallel_segments": pool_segments},
@@ -688,6 +719,8 @@ def simulate_trace(
     result_cache=None,
     workers: int | None = None,
     pricing_backend: str | None = None,
+    cancel=None,
+    max_wall_s: float | None = None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -712,11 +745,20 @@ def simulate_trace(
     bit-identical to the serial path.  ``pricing_backend`` (the
     ``--pricing-backend`` flag / ``$TPUSIM_PRICING_BACKEND``) pins the
     tpusim.fastpath engine backend (auto/serial/vectorized/native; all
-    byte-identical) and stamps the ``fastpath_*`` stats block."""
+    byte-identical) and stamps the ``fastpath_*`` stats block.
+    ``cancel`` (a :class:`tpusim.guard.CancelToken`) / ``max_wall_s``
+    (the ``--max-wall-s`` flag) make the replay cooperatively
+    cancellable: a tripped token raises
+    :class:`tpusim.guard.OperationCancelled` at the next command/op
+    boundary instead of pricing to completion."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
     obs = obs if obs is not None else NULL_OBS
+    if max_wall_s is not None and cancel is None:
+        from tpusim.guard.cancel import CancelToken
+
+        cancel = CancelToken.after(max_wall_s)
     if validate:
         from tpusim.analysis import (
             Severity, ValidationError, analyze_trace_dir,
@@ -752,5 +794,5 @@ def simulate_trace(
         return SimDriver(
             cfg, topology=topology, obs=obs, faults=faults,
             result_cache=result_cache, workers=workers,
-            pricing_backend=pricing_backend,
+            pricing_backend=pricing_backend, cancel=cancel,
         ).run(pod)

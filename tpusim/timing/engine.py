@@ -451,8 +451,16 @@ class Engine:
         clock_scale: float = 1.0,
         hbm_scale: float = 1.0,
         pricing_backend: str | None = None,
+        cancel=None,
     ):
         self.config = config
+        # cooperative cancellation (tpusim.guard): a CancelToken checked
+        # every CHECK_EVERY_OPS ops in the serial walk and between
+        # compiled blocks in the fastpath.  None (the default) keeps the
+        # hot loop at one pointer compare per stride — the healthy path
+        # is arithmetically untouched either way (cancellation changes
+        # WHETHER a result is produced, never its value).
+        self.cancel = cancel
         self.arch = config.arch
         self.cost = cost_model or CostModel(self.arch)
         # fastpath compile results are shared process-wide only for the
@@ -584,6 +592,14 @@ class Engine:
         if depth > 32:
             return t0
         a = self.arch
+        # cooperative cancellation (tpusim.guard): one pointer compare
+        # per op when un-governed; a real deadline/cancel check every
+        # CHECK_EVERY_OPS ops.  Cancellation changes WHETHER a result is
+        # produced, never its value — an armed-but-untripped token walk
+        # is arithmetically identical to an unarmed one.
+        cancel = self.cancel
+        if cancel is not None:
+            from tpusim.guard.cancel import CHECK_EVERY_OPS as _stride
         # self-profiling accumulators (tpusim.obs): wall seconds spent in
         # the cost model and ICI pricing inside this walk, reported once
         # at the end — per-op span objects would cost more than the ops
@@ -618,6 +634,8 @@ class Engine:
         skipped_starts: set[str] = set()
 
         for op_index, op in enumerate(comp.ops):
+            if cancel is not None and op_index % _stride == 0:
+                cancel.check()
             if checkpoint_op and op_index >= checkpoint_op:
                 break
             if resume_op and op_index < resume_op:
