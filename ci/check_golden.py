@@ -1127,6 +1127,285 @@ def guard_smoke(serve_workers: int = 2) -> dict:
     }
 
 
+def front_smoke(serve_workers: int = 1) -> dict:
+    """serve v3 front-tier contract (multi-acceptor + hot cache):
+
+    1. **byte-identity across every topology**: the golden matrix served
+       through acceptors=1 and acceptors=2, each with and without the
+       shared mmap hot-response cache, must answer every request
+       byte-identical to the committed CLI goldens;
+    2. **the hot tier really serves**: on the hot legs the warm second
+       pass must be answered entirely from the mmap (``cache_hit`` on
+       every response, ``serve_hot_hits_total`` >= the matrix size, and
+       ``serve_priced_total`` frozen at the cold pass — zero worker
+       dispatches);
+    3. **chaos**: an acceptor SIGKILLed mid-matrix costs zero failed
+       requests (the client's idempotent-retry discipline reconnects
+       onto a surviving acceptor) and the front supervisor heals the
+       fleet;
+    4. **guard semantics hold multi-acceptor**: a request past its
+       deadline still 504s through cooperative in-process cancel, and a
+       poison request quarantined behind one acceptor is refused by the
+       OTHER acceptor immediately (shared quarantine state) without
+       costing it any worker deaths.
+    Raises on violation."""
+    import tempfile
+    import threading
+    import time
+
+    from tpusim.serve.client import ServeClient, ServeError
+    from tpusim.serve.front import FrontSupervisor
+
+    golden_bytes = _serve_golden_bytes
+    served_bytes = _serve_served_bytes
+
+    def matrix_names():
+        out = []
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            out.append((name, fixture, arch, overlays))
+        return out
+
+    def matrix_pass(url, fresh_conns: bool):
+        """One pass; fresh_conns opens a new connection per request so
+        the kernel's reuseport hashing spreads them over acceptors."""
+        out = []
+        client = None
+        for name, fixture, arch, overlays in matrix_names():
+            if client is None or fresh_conns:
+                client = ServeClient(url, retries=3)
+            r = client.simulate(
+                trace=fixture, arch=arch, overlays=list(overlays),
+                tuned=False,
+            )
+            if served_bytes(r.stats) != golden_bytes(name):
+                raise ValueError(
+                    f"front smoke: served stats for {name} diverged "
+                    f"from the committed CLI golden"
+                )
+            out.append((name, r))
+        return out
+
+    def metric(client, key) -> float:
+        for line in client.metrics_text().splitlines():
+            if line.startswith(f"tpusim_{key} "):
+                return float(line.split()[1])
+        return 0.0
+
+    legs = []
+    n = len(MATRIX)
+    for acceptors, hot in ((1, False), (2, False), (1, True), (2, True)):
+        with tempfile.TemporaryDirectory(
+            prefix="tpusim_front_smoke_"
+        ) as td:
+            front = FrontSupervisor(
+                settings={
+                    "trace_root": str(FIXTURES), "max_inflight": 4,
+                    "hot_cache": f"{td}/hot" if hot else None,
+                },
+                num_acceptors=acceptors,
+            ).start()
+            try:
+                client = ServeClient(front.url, retries=3)
+                matrix_pass(front.url, fresh_conns=acceptors > 1)
+                if hot:
+                    # every unique request publishes once; wait for the
+                    # async post-response publishes to land before the
+                    # warm pass claims to be served from the map
+                    deadline = time.monotonic() + 5.0
+                    while (
+                        metric(client, "serve_hot_publishes_total") < n
+                        and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.05)
+                warm = matrix_pass(front.url, fresh_conns=acceptors > 1)
+                if hot:
+                    missed = [nm for nm, r in warm if not r.cache_hit]
+                    if missed:
+                        raise ValueError(
+                            f"front smoke: hot-leg warm responses "
+                            f"without cache_hit: {missed}"
+                        )
+                    hot_hits = metric(client, "serve_hot_hits_total")
+                    priced = metric(client, "serve_priced_total")
+                    if hot_hits < n:
+                        raise ValueError(
+                            f"front smoke: warm pass recorded only "
+                            f"{hot_hits:.0f} hot hits (expected >= {n})"
+                        )
+                    if priced > n:
+                        raise ValueError(
+                            f"front smoke: {priced:.0f} requests were "
+                            f"priced (expected {n}: the warm pass must "
+                            f"dispatch ZERO work past the mmap tier)"
+                        )
+                legs.append({
+                    "acceptors": acceptors, "hot": hot, "configs": n,
+                })
+            finally:
+                if not front.stop():
+                    raise ValueError(
+                        f"front smoke: fleet (acceptors={acceptors}, "
+                        f"hot={hot}) did not drain cleanly"
+                    )
+
+    # -- chaos: SIGKILL an acceptor mid-matrix ------------------------------
+    with tempfile.TemporaryDirectory(prefix="tpusim_front_chaos_") as td:
+        front = FrontSupervisor(
+            settings={
+                "trace_root": str(FIXTURES), "max_inflight": 4,
+                "hot_cache": f"{td}/hot",
+            },
+            num_acceptors=2, restart_backoff_s=0.1,
+        ).start()
+        try:
+            matrix_pass(front.url, fresh_conns=True)  # warm + publish
+            killed = {"pid": None}
+
+            def chaos():
+                time.sleep(0.05)
+                killed["pid"] = front.slots[1].pid
+                front.kill_acceptor(1)
+
+            t = threading.Thread(target=chaos, daemon=True)
+            t.start()
+            for _ in range(3):
+                matrix_pass(front.url, fresh_conns=True)
+            t.join(timeout=5.0)
+            if killed["pid"] is None:
+                raise ValueError("front smoke: chaos kill never landed")
+            # wait for the RESTART, not mere aliveness: the alive flag
+            # only drops once the monitor notices the death, so an
+            # alive-count poll can win the race and see nothing
+            deadline = time.monotonic() + 20.0
+            while (
+                front.slots[1].restarts < 1 or not front.slots[1].alive
+            ) and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if front.slots[1].restarts < 1 or not front.slots[1].alive:
+                raise ValueError(
+                    "front smoke: fleet did not heal after the "
+                    "acceptor SIGKILL (no restart recorded)"
+                )
+            chaos_restarts = front.slots[1].restarts
+            # the healed fleet still serves golden bytes
+            matrix_pass(front.url, fresh_conns=True)
+        finally:
+            front.stop()
+
+    # -- guard semantics across acceptors -----------------------------------
+    with tempfile.TemporaryDirectory(prefix="tpusim_front_guard_") as td:
+        # 2+ workers per acceptor: the poison retry must find a LIVE
+        # second worker (one alive worker would shed Degraded instead
+        # of spending the retry budget)
+        front = FrontSupervisor(
+            settings={
+                "trace_root": str(FIXTURES), "max_inflight": 4,
+                "workers_per_acceptor": max(serve_workers, 2),
+                "chaos_hooks": True,
+                "quarantine_dir": f"{td}/quarantine",
+            },
+            num_acceptors=2,
+        ).start()
+        try:
+            client = ServeClient(front.url, retries=3)
+            client.simulate(trace="matmul_512", arch="v5e", tuned=False)
+            # deadline past the budget: in-process cooperative cancel,
+            # exactly the single-daemon guard contract
+            resp, payload = client._raw("POST", "/v1/simulate", {
+                "trace": "matmul_512", "arch": "v5e", "tuned": False,
+                "_chaos_spin_s": 10, "deadline_ms": 400,
+            })
+            doc = json.loads(payload)
+            if resp.status != 504 or "cooperative" not in str(
+                doc.get("detail", "")
+            ):
+                raise ValueError(
+                    f"front smoke: expected in-process-cancel 504 "
+                    f"through the front tier, got {resp.status} "
+                    f"{doc.get('detail')!r}"
+                )
+            # poison: kills its worker past the retry budget -> 422
+            poison_body = {
+                "trace": "matmul_512", "arch": "v5e", "tuned": False,
+                "_chaos_exit": True,
+            }
+            status = None
+            try:
+                resp, payload = client._raw(
+                    "POST", "/v1/simulate", poison_body,
+                )
+                status = resp.status
+            except ServeError:
+                pass
+            if status != 422:
+                raise ValueError(
+                    f"front smoke: poison request answered {status}, "
+                    f"expected 422 after the retry budget"
+                )
+            # find the victim acceptor and the innocent one
+            health = client.healthz()
+            victims, innocents = [], []
+            for acc in health.get("acceptors", []):
+                crashes = sum(
+                    w.get("crashes", 0) for w in acc.get("workers", [])
+                )
+                (victims if crashes else innocents).append(acc)
+            if not victims or not innocents:
+                raise ValueError(
+                    f"front smoke: could not identify poison victim/"
+                    f"innocent acceptors in {health}"
+                )
+            innocent = innocents[0]
+            direct = ServeClient(
+                f"http://127.0.0.1:{innocent['direct_port']}"
+            )
+            try:
+                resp, payload = direct._raw(
+                    "POST", "/v1/simulate", poison_body,
+                )
+                status2 = resp.status
+            except ServeError:
+                status2 = None
+            if status2 != 422:
+                raise ValueError(
+                    f"front smoke: the innocent acceptor answered "
+                    f"{status2} for the quarantined body (expected an "
+                    f"immediate 422 from the SHARED quarantine)"
+                )
+            after = direct.healthz(timeout_s=10)
+            crashes_after = sum(
+                w.get("crashes", 0) for w in (
+                    next(
+                        a for a in after.get("acceptors", [])
+                        if a.get("acceptor_index")
+                        == innocent.get("acceptor_index")
+                    ).get("workers", [])
+                )
+            )
+            if crashes_after:
+                raise ValueError(
+                    "front smoke: the shared quarantine did not refuse "
+                    "the poison body before it killed the innocent "
+                    "acceptor's worker"
+                )
+        finally:
+            if not front.stop():
+                raise ValueError(
+                    "front smoke: guard fleet did not drain cleanly"
+                )
+
+    return {
+        "legs": legs,
+        "configs": n,
+        "chaos_restarts": chaos_restarts,
+        "serve_workers": max(serve_workers, 1),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -1165,6 +1444,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--serve-workers", type=int, default=2, metavar="N",
                     help="worker count for the multi-worker serve legs "
                          "(default 2)")
+    ap.add_argument("--front-smoke", action="store_true",
+                    help="serve v3 front-tier contract: the golden "
+                         "matrix byte-identical across acceptors=1 and "
+                         "acceptors=2 (with and without the shared mmap "
+                         "hot-response cache), the warm pass served "
+                         "from the mmap tier with zero worker "
+                         "dispatches, an acceptor SIGKILLed mid-matrix "
+                         "costing zero failed requests, and guard "
+                         "deadline-504 / shared-quarantine semantics "
+                         "holding across acceptors")
     ap.add_argument("--advise-smoke", action="store_true",
                     help="run the fixed-spec sharding-advisor sweep on "
                          "the llama_tiny fixture: the ranked report "
@@ -1254,6 +1543,24 @@ def main(argv: list[str] | None = None) -> int:
               f"capacity answer {summary['capacity']!r}, healthy "
               f"matrix unchanged across {summary['matrix_configs']} "
               f"configs)")
+        return 0
+
+    if args.front_smoke:
+        try:
+            summary = front_smoke(
+                serve_workers=max(args.serve_workers - 1, 1)
+            )
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --front-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --front-smoke: OK "
+              f"({len(summary['legs'])} topologies x "
+              f"{summary['configs']} configs byte-identical to CLI "
+              f"goldens; hot warm passes served from the mmap tier "
+              f"with zero dispatches; acceptor SIGKILL healed with "
+              f"{summary['chaos_restarts']} restart(s) and zero failed "
+              f"requests; coop-504 + shared quarantine held across "
+              f"acceptors)")
         return 0
 
     if args.serve_smoke:

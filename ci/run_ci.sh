@@ -48,35 +48,43 @@
 #                must finish with zero failed requests (the killed
 #                request retried on a fresh worker, still golden) and
 #                at least one recorded worker restart
-#  12. campaign — campaign-layer determinism: a fixed-seed 16-scenario
+#  12. front   — serve v3 front-tier contract: the golden matrix
+#                byte-identical across acceptors=1 and acceptors=2
+#                (with and without the shared mmap hot-response
+#                cache), warm passes served from the mmap tier with
+#                zero worker dispatches, an acceptor SIGKILLed
+#                mid-matrix costing zero failed requests, and guard
+#                deadline-504 / shared-quarantine semantics holding
+#                across acceptors
+#  13. campaign — campaign-layer determinism: a fixed-seed 16-scenario
 #                Monte-Carlo compound-fault campaign on the llama_tiny
 #                fixture must reproduce the committed report
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  13. advise  — sharding-advisor determinism: a fixed-spec strategy
+#  14. advise  — sharding-advisor determinism: a fixed-spec strategy
 #                sweep on the llama_tiny fixture must reproduce the
 #                committed ranked report byte-for-byte (step-time/
 #                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  14. guard   — resource-governance contract (tpusim.guard): the
+#  15. guard   — resource-governance contract (tpusim.guard): the
 #                golden matrix under a small --cache-quota stays
 #                byte-identical while the cache dir never exceeds the
 #                quota (LRU GC provably engaged), and a served request
 #                past its deadline 504s through cooperative in-process
 #                cancellation with the worker still alive (zero
 #                restarts/kills, warm caches serving the next request)
-#  15. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  16. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-14
+# Usage:  bash ci/run_ci.sh            # tiers 1-15
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/15] build native from source (+ native parity suite) ==="
+echo "=== [1/16] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -90,50 +98,53 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/15] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/16] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/15] unit tests (fast tier) ==="
+echo "=== [3/16] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/15] golden-stat regression sims ==="
+echo "=== [4/16] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/15] obs export smoke (schema-checked) ==="
+echo "=== [5/16] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/15] faults smoke (degraded-pod contract) ==="
+echo "=== [6/16] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/15] trace/config/schedule lint smoke ==="
+echo "=== [7/16] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/15] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/16] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/15] fastpath parity (pricing-backend byte-identity) ==="
+echo "=== [9/16] fastpath parity (pricing-backend byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/15] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/16] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/15] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/16] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/15] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [12/16] front smoke (serve v3 multi-acceptor contract) ==="
+python ci/check_golden.py --front-smoke
+
+echo "=== [13/16] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [13/15] advise smoke (sharding-advisor determinism) ==="
+echo "=== [14/16] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [14/15] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [15/16] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [15/15] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [16/16] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [15/15] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [16/16] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -134,3 +134,59 @@ def live_jax():
     """Depend on this before any in-process ``import jax``."""
     if not live_jax_usable():
         pytest.skip("live JAX backend unreachable (axon TPU tunnel down)")
+
+
+# -- jax.shard_map availability ----------------------------------------------
+#
+# The model zoo's sharded workloads (ring/ulysses attention, MoE ep,
+# pipeline pp, sharded decode, the ici_allreduce microbench) build through
+# the `jax.shard_map` entry point.  JAX has moved this surface across
+# releases; on containers whose build does not expose it, every
+# subprocess-mesh test that builds one of those workloads dies with
+# AttributeError — an environment gap, not a model bug.  Probe once per
+# session (in a subprocess, the same CPU-mesh environment the tests use)
+# and skip with a clear reason, mirroring the xplane/ProfileData gates.
+
+_SHARD_MAP_PROBE = Path("/tmp/tpusim_shard_map_probe")
+_shard_map_ok: bool | None = None
+
+
+def jax_shard_map_usable(timeout: int = 120) -> bool:
+    global _shard_map_ok
+    if _shard_map_ok is None:
+        try:
+            import time
+
+            age = time.time() - _SHARD_MAP_PROBE.stat().st_mtime
+            if age < _PROBE_TTL_S:
+                _shard_map_ok = _SHARD_MAP_PROBE.read_text().strip() == "1"
+                return _shard_map_ok
+        except OSError:
+            pass
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; raise SystemExit("
+                 "0 if hasattr(jax, 'shard_map') else 3)"],
+                env=cpu_mesh_env(2),
+                capture_output=True,
+                timeout=timeout,
+                cwd=REPO_ROOT,
+            )
+            _shard_map_ok = proc.returncode == 0
+        except (subprocess.TimeoutExpired, OSError):
+            _shard_map_ok = False
+        try:
+            _SHARD_MAP_PROBE.write_text("1" if _shard_map_ok else "0")
+        except OSError:
+            pass
+    return _shard_map_ok
+
+
+def require_jax_shard_map() -> None:
+    """Skip (never error) when this jax build lacks ``jax.shard_map``."""
+    if not jax_shard_map_usable():
+        pytest.skip(
+            "jax.shard_map entry point absent in this jax build "
+            "(jax-drift): the sharded model-zoo workloads cannot build"
+        )

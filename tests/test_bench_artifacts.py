@@ -18,6 +18,8 @@ from pathlib import Path
 
 import pytest
 
+from tests.conftest import require_jax_shard_map
+
 REPO_ROOT = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "fixtures"
 
@@ -94,6 +96,7 @@ def test_bench_last_json_line_parser():
 def test_dryrun_multichip_from_poisoned_env():
     """Running __graft_entry__ from the *inherited* environment (axon site
     active) must still complete: the parent re-execs into a clean CPU mesh."""
+    require_jax_shard_map()
     proc = _run([sys.executable, "__graft_entry__.py", "4"], timeout=420)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "llama tiny train step" in proc.stdout

@@ -136,6 +136,7 @@ print("CORREL_OPS_OK")
 
 @pytest.mark.slow
 def test_correlate_workload_ops_end_to_end(tmp_path, cpu_mesh_runner):
+    _require_xplane_support()
     out = cpu_mesh_runner(
         CORREL_SCRIPT.replace(
             "OUT", repr(str(tmp_path / "correl_ops.json"))

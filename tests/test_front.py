@@ -1,0 +1,475 @@
+"""serve v3 — multi-acceptor front tier + shared mmap hot-response cache.
+
+Covers the new contracts on top of tests/test_serve.py's daemon suite:
+
+* :class:`~tpusim.serve.hotcache.HotResponseCache` unit behavior —
+  publish/read round trip across instances (processes), generation
+  invalidation, quota rotation, concurrent publishers;
+* the hot path end-to-end: a warm repeat served from the mmap is
+  byte-identical (modulo wall-clock keys) to a REAL warm priced
+  response, ``cache_hit`` true, counted on /metrics;
+* the front fleet: SO_REUSEPORT acceptors behind one port, fleet
+  /healthz + /metrics merges, job-family routes proxied to the primary
+  acceptor, acceptor SIGKILL healing under live traffic, and the
+  fd-passing fallback (``TPUSIM_NO_REUSEPORT=1``);
+* the client's serve v3 retry discipline: idempotent POSTs (simulate)
+  reconnect-and-retry through a connection reset from a recycled
+  acceptor; job submissions are still never replayed.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpusim.serve.client import ServeClient, ServeError
+from tpusim.serve.daemon import ServeDaemon
+from tpusim.serve.front import FrontSupervisor
+from tpusim.serve.hotcache import HotResponseCache
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "traces"
+
+VOLATILE = {"simulation_rate_kops", "wall_seconds", "silicon_slowdown"}
+
+
+def canonical(stats: dict) -> str:
+    return json.dumps(
+        {k: v for k, v in stats.items() if k not in VOLATILE},
+        indent=1, sort_keys=True,
+    )
+
+
+def _metric(text: str, key: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(f"tpusim_{key} "):
+            return float(line.split()[1])
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# HotResponseCache unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_hotcache_round_trip_across_instances(tmp_path):
+    """A publish by one instance is readable by a second instance on
+    the same dir (the cross-process shape, minus the fork)."""
+    a = HotResponseCache(tmp_path, generation="g1")
+    assert a.get("k") is None
+    assert a.publish("k", b'{"x": 1}\n')
+    got = a.get("k")
+    assert bytes(got) == b'{"x": 1}\n'
+    b = HotResponseCache(tmp_path, generation="g1")
+    assert bytes(b.get("k")) == b'{"x": 1}\n'
+    # first writer wins; a duplicate publish is a no-op
+    assert not b.publish("k", b"other")
+    assert bytes(a.get("k")) == b'{"x": 1}\n'
+    assert "k" in a and "missing" not in a
+
+
+def test_hotcache_generation_invalidation(tmp_path):
+    """A generation bump (model_version / format / tuned state moved)
+    orphans the old entries AND reaps the old files."""
+    old = HotResponseCache(tmp_path, generation="old1")
+    old.publish("k", b"stale")
+    new = HotResponseCache(tmp_path, generation="new2")
+    assert new.get("k") is None
+    names = {p.name for p in tmp_path.iterdir()}
+    assert not any("old1" in n for n in names), names
+
+
+def test_hotcache_quota_rotation(tmp_path):
+    """Past the quota the writer rotates to a fresh epoch segment; old
+    keys become misses (repopulated in one request each), the new key
+    serves, and the store never exceeds the quota."""
+    c = HotResponseCache(tmp_path, generation="g", quota_bytes=1 << 16)
+    body = b"x" * 4096
+    for i in range(20):  # 80 KB through a 64 KB quota
+        assert c.publish(f"k{i}", body)
+    assert c.rotations >= 1
+    seg = tmp_path / c._read_index_doc()["segment"]
+    assert seg.stat().st_size <= 1 << 16
+    assert bytes(c.get("k19")) == body   # newest entry survives
+    assert c.get("k0") is None           # rotated away
+
+
+def test_hotcache_oversized_body_never_publishes(tmp_path):
+    c = HotResponseCache(tmp_path, generation="g", quota_bytes=1 << 16)
+    assert not c.publish("big", b"x" * (1 << 15))  # > quota/8
+    assert c.get("big") is None
+
+
+def test_hotcache_concurrent_publishers(tmp_path):
+    """Two instances racing distinct keys (the two-acceptor shape):
+    every key lands, every body reads back exactly."""
+    a = HotResponseCache(tmp_path, generation="g")
+    b = HotResponseCache(tmp_path, generation="g")
+    errors = []
+
+    def pump(cache, prefix):
+        try:
+            for i in range(25):
+                cache.publish(f"{prefix}{i}", f"{prefix}{i}".encode())
+        except Exception as e:  # noqa: BLE001 - the assertion below
+            errors.append(e)
+
+    ts = [
+        threading.Thread(target=pump, args=(a, "a")),
+        threading.Thread(target=pump, args=(b, "b")),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+    reader = HotResponseCache(tmp_path, generation="g")
+    for prefix in ("a", "b"):
+        for i in range(25):
+            assert bytes(reader.get(f"{prefix}{i}")) == \
+                f"{prefix}{i}".encode()
+
+
+# ---------------------------------------------------------------------------
+# Hot path end-to-end (standalone daemon)
+# ---------------------------------------------------------------------------
+
+
+def test_hot_served_bytes_match_real_warm_response(tmp_path):
+    """The hot tier stores SYNTHESIZED warm-form bytes on first
+    pricing; they must equal what a real (result-cache-hit) warm
+    response produces — cache accounting included, wall-clock keys
+    excepted."""
+    hot = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4, hot_cache=tmp_path / "hot",
+    ).start()
+    plain = ServeDaemon(trace_root=FIXTURES, max_inflight=4).start()
+    try:
+        ch = ServeClient(hot.url)
+        cp = ServeClient(plain.url)
+        first = ch.simulate(trace="matmul_512", arch="v5e")
+        assert not first.cache_hit
+        served = ch.simulate(trace="matmul_512", arch="v5e")
+        assert served.cache_hit  # from the mmap, warm form
+        assert _metric(ch.metrics_text(), "serve_hot_hits_total") == 1
+        cp.simulate(trace="matmul_512", arch="v5e")
+        real_warm = cp.simulate(trace="matmul_512", arch="v5e")
+        assert real_warm.cache_hit
+        assert canonical(served.stats) == canonical(real_warm.stats)
+        # the accounting keys too: synthesis must fold misses exactly
+        assert served.stats.get("cache_hits") == \
+            real_warm.stats.get("cache_hits")
+        assert served.stats.get("cache_misses") == \
+            real_warm.stats.get("cache_misses")
+    finally:
+        hot.drain_and_stop()
+        plain.drain_and_stop()
+
+
+def test_hot_entries_keyed_by_trace_content(tmp_path):
+    """A hot dir surviving a restart must not serve bytes priced from
+    DIFFERENT on-disk trace content: the key carries a stat fingerprint
+    of the trace dir."""
+    d = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4, hot_cache=tmp_path / "hot",
+    )
+    body = {"trace": "matmul_512", "arch": "v5e", "tuned": True}
+    k1 = d.hot_key_for("simulate", body)
+    assert k1 is not None
+    # unknown traces are not hot-servable (the 404 path answers)
+    assert d.hot_key_for("simulate", {"trace": "nope"}) is None
+    # a different fingerprint yields a different key
+    d2 = ServeDaemon(
+        trace_root=FIXTURES, max_inflight=4, hot_cache=tmp_path / "hot",
+    )
+    d2._trace_fp_cache["matmul_512"] = "different"
+    assert d2.hot_key_for("simulate", body) != k1
+    # volatile keys never fragment the hot tier
+    assert d.hot_key_for(
+        "simulate", {**body, "deadline_ms": 123}
+    ) == k1
+
+
+# ---------------------------------------------------------------------------
+# Front fleet (SO_REUSEPORT acceptors)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def front(tmp_path_factory):
+    # Acceptors pin their environment AT FORK TIME.  This module-scoped
+    # fixture is created before the function-scoped autouse
+    # ``_isolate_tuned_overlays`` patch, so without pinning here the
+    # fleet would fork with the repo's tuned overlays visible while
+    # in-process daemons compose lazily under the per-test isolation —
+    # byte-identity would then fail on a config skew, not a front bug
+    # (the exact serve v2 pool-fixture lesson).
+    import os
+
+    old = os.environ.get("TPUSIM_TUNED_DIR")
+    os.environ["TPUSIM_TUNED_DIR"] = str(
+        tmp_path_factory.mktemp("no_tuned_front")
+    )
+    td = tmp_path_factory.mktemp("front")
+    f = FrontSupervisor(
+        settings={
+            "trace_root": str(FIXTURES),
+            "max_inflight": 4,
+            "hot_cache": str(td / "hot"),
+            "quarantine_dir": str(td / "quarantine"),
+        },
+        num_acceptors=2,
+        restart_backoff_s=0.1,
+    ).start()
+    try:
+        yield f
+    finally:
+        f.stop()
+        if old is None:
+            os.environ.pop("TPUSIM_TUNED_DIR", None)
+        else:
+            os.environ["TPUSIM_TUNED_DIR"] = old
+
+
+@pytest.fixture(scope="module")
+def front_client(front):
+    return ServeClient(front.url, retries=3)
+
+
+def test_front_round_trip_and_fleet_health(front, front_client):
+    health = front_client.healthz()
+    assert health["status"] == "ok"
+    assert health["acceptors_alive"] == 2
+    assert health["acceptors_configured"] == 2
+    indices = {a["acceptor_index"] for a in health["acceptors"]}
+    assert indices == {0, 1}
+    primary = [a for a in health["acceptors"] if a.get("primary")]
+    assert len(primary) == 1 and primary[0]["acceptor_index"] == 0
+    r = front_client.simulate(trace="matmul_512", arch="v5e")
+    assert r.sim_cycles > 0
+
+
+def test_front_served_bytes_match_plain_daemon(front_client):
+    """Byte-identity across topologies: the fleet's response equals the
+    standalone daemon's for the same request."""
+    plain = ServeDaemon(trace_root=FIXTURES, max_inflight=4).start()
+    try:
+        want = ServeClient(plain.url).simulate(
+            trace="llama_tiny_tp2dp2", arch="v5p",
+        )
+        got = front_client.simulate(trace="llama_tiny_tp2dp2", arch="v5p")
+        assert canonical(got.stats) == canonical(want.stats)
+    finally:
+        plain.drain_and_stop()
+
+
+def test_front_warm_repeat_is_hot_hit(front, front_client):
+    before = _metric(
+        front_client.metrics_text(), "serve_hot_hits_total",
+    )
+    front_client.simulate(trace="matmul_512", arch="v5e")  # publishes
+    r = front_client.simulate(trace="matmul_512", arch="v5e")
+    assert r.cache_hit
+    after = _metric(front_client.metrics_text(), "serve_hot_hits_total")
+    assert after > before
+
+
+def test_front_metrics_merge_is_fleet_wide(front, front_client):
+    """/metrics merges every acceptor; ?scope=local stays one
+    acceptor's view."""
+    fleet = front_client.metrics_text()
+    assert _metric(fleet, "serve_acceptors_alive") == 2
+    assert _metric(fleet, "serve_acceptors_configured") == 2
+    # fleet-summed request counter >= any local view's
+    resp, payload = front_client._raw("GET", "/metrics?scope=local")
+    local = payload.decode()
+    assert "serve_acceptors_alive" not in local
+    assert _metric(fleet, "serve_requests_total") >= _metric(
+        local, "serve_requests_total",
+    )
+
+
+def test_front_jobs_proxied_to_primary(front, front_client):
+    """Async jobs work through ANY acceptor: submissions and polls on
+    secondaries proxy to the primary's JobTable (single-owner ids)."""
+    ids = set()
+    for _ in range(4):  # fresh connections spread over acceptors
+        c = ServeClient(front.url)
+        jid = c.sweep(arch="v5p", chips=8, payload_mb=1)
+        st = c.wait_job(jid, timeout_s=60)
+        assert st.status == "done"
+        ids.add(jid)
+    assert len(ids) == 4  # one id space: the primary owns the table
+
+
+def test_front_acceptor_kill_heals_under_traffic(front, front_client):
+    """SIGKILL one acceptor mid-traffic: zero client-visible failures
+    (idempotent simulate retries reconnect onto the survivor), the
+    front supervisor respawns the slot, and the fleet view recovers."""
+    front_client.simulate(trace="matmul_512", arch="v5e")  # hot
+    boots_before = front.slots[1].boots
+    for i in range(30):
+        if i == 5:
+            front.kill_acceptor(1)
+        r = ServeClient(front.url, retries=3).simulate(
+            trace="matmul_512", arch="v5e",
+        )
+        assert r.cache_hit
+    deadline = time.monotonic() + 20
+    while (
+        front.slots[1].boots <= boots_before
+        or not front.slots[1].alive
+    ) and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert front.slots[1].boots > boots_before
+    assert front.slots[1].alive
+    health = front_client.healthz()
+    assert health["acceptors_alive"] == 2
+
+
+def test_front_fd_fallback_serves(tmp_path, monkeypatch):
+    """TPUSIM_NO_REUSEPORT=1 forces the parent-accept + send_fds path:
+    same API, same bytes, one extra syscall per connection."""
+    monkeypatch.setenv("TPUSIM_NO_REUSEPORT", "1")
+    f = FrontSupervisor(
+        settings={
+            "trace_root": str(FIXTURES), "max_inflight": 4,
+            "hot_cache": str(tmp_path / "hot"),
+        },
+        num_acceptors=2,
+    ).start()
+    try:
+        assert not f.reuse_port
+        c = ServeClient(f.url)
+        assert c.healthz()["acceptors_alive"] == 2
+        r1 = c.simulate(trace="matmul_512", arch="v5e")
+        r2 = c.simulate(trace="matmul_512", arch="v5e")
+        assert not r1.cache_hit and r2.cache_hit
+    finally:
+        assert f.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shared quarantine (fleet-wide poison refusal)
+# ---------------------------------------------------------------------------
+
+
+def test_shared_quarantine_across_supervisors(tmp_path):
+    """A poison verdict published by one acceptor's supervisor refuses
+    the same body in ANOTHER acceptor's supervisor immediately — no
+    worker deaths spent re-learning it."""
+    from tpusim.serve.supervisor import Supervisor
+    from tpusim.serve.worker import RequestError
+
+    qdir = tmp_path / "quarantine"
+    sup_a = Supervisor({}, num_workers=1, quarantine_dir=qdir)
+    sup_b = Supervisor({}, num_workers=1, quarantine_dir=qdir)
+    body = {"trace": "t", "hlo_text": None}
+    key = Supervisor.affinity_key("simulate", body)
+    sup_a._quarantine_add(key, "simulate", body, "killed 2 workers")
+    with pytest.raises(RequestError) as ei:
+        sup_b.execute("simulate", body)
+    assert ei.value.status == 422
+    assert ei.value.extra["poison"]["content_hash"] == key
+    assert sup_b.poisoned == 1
+
+
+# ---------------------------------------------------------------------------
+# Client retry discipline (serve v3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def resetting_server():
+    """A server that RESETS the first connection after reading its
+    request (the recycled-acceptor stand-in), then answers normally."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    seen = []
+    doc = {
+        "format_version": 1, "model_version": "t", "trace": "matmul_512",
+        "arch": "v5e", "num_devices": 1, "sim_cycles": 7.0,
+        "cache_hit": True, "stats": {}, "job_id": "job-000001",
+        "status": "queued",
+    }
+    body = json.dumps(doc).encode()
+    response = (
+        b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+    )
+
+    def acceptor():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            seen.append(conn)
+            try:
+                conn.settimeout(5.0)
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += conn.recv(65536)
+                head = buf.partition(b"\r\n\r\n")[0]
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length"):
+                        clen = int(line.split(b":", 1)[1])
+                got = buf.partition(b"\r\n\r\n")[2]
+                while len(got) < clen:
+                    got += conn.recv(65536)
+                if len(seen) == 1:
+                    # read fully, then RST: the request was sent AND
+                    # received, the response never came — exactly a
+                    # SIGKILLed acceptor mid-response
+                    conn.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                    )
+                    conn.close()
+                    continue
+                conn.sendall(response)
+                conn.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    try:
+        yield srv.getsockname(), seen
+    finally:
+        srv.close()
+
+
+def test_client_retries_idempotent_post_on_reset(resetting_server):
+    """A fully-sent /v1/simulate POST whose connection is reset by a
+    recycled acceptor IS retried (pricing is pure), and succeeds on the
+    reconnect."""
+    (host, port), seen = resetting_server
+    c = ServeClient(
+        f"http://{host}:{port}", timeout_s=5.0, retries=2,
+        backoff_base_s=0.01,
+    )
+    r = c.simulate(trace="matmul_512", arch="v5e")
+    assert r.sim_cycles == 7.0
+    assert len(seen) == 2  # the reset attempt + the successful retry
+
+
+def test_client_never_replays_job_submission_on_reset(resetting_server):
+    """The same reset on a JOB submission is NOT retried: the server
+    may have enqueued the job before dying."""
+    (host, port), seen = resetting_server
+    c = ServeClient(
+        f"http://{host}:{port}", timeout_s=5.0, retries=3,
+        backoff_base_s=0.01,
+    )
+    with pytest.raises(ServeError) as ei:
+        c.sweep(arch="v5p", chips=8)
+    assert ei.value.code == "connection_failed"
+    assert len(seen) == 1  # one attempt, no replay

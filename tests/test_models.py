@@ -4,7 +4,7 @@ framework's version of the reference's self-checking rodinia apps
 
 import pytest
 
-from tests.conftest import run_in_cpu_mesh
+from tests.conftest import require_jax_shard_map, run_in_cpu_mesh
 from tpusim.models import get_workload, list_workloads
 
 
@@ -80,6 +80,7 @@ print("RING_OK")
 
 @pytest.mark.slow
 def test_ring_and_ulysses_match_dense_attention():
+    require_jax_shard_map()
     out = run_in_cpu_mesh(RING_CORRECTNESS_SCRIPT, n_devices=8)
     assert "RING_OK" in out
 
@@ -132,6 +133,7 @@ print("MOE_OK")
 
 @pytest.mark.slow
 def test_moe_expert_parallel(cpu_mesh_runner):
+    require_jax_shard_map()
     out = cpu_mesh_runner(MOE_SELFCHECK_SCRIPT, n_devices=8)
     assert "MOE_OK" in out
 
@@ -159,6 +161,7 @@ print("PP_OK")
 
 @pytest.mark.slow
 def test_pipeline_matches_sequential(cpu_mesh_runner):
+    require_jax_shard_map()
     out = cpu_mesh_runner(PIPELINE_SCRIPT, n_devices=4)
     assert "PP_OK" in out
 
@@ -275,5 +278,6 @@ print("DECODE_TP_OK")
 
 @pytest.mark.slow
 def test_decode_tp8_matches_single_chip():
+    require_jax_shard_map()
     out = run_in_cpu_mesh(DECODE_TP_SCRIPT, n_devices=8)
     assert "DECODE_TP_OK" in out

@@ -10,7 +10,7 @@ import sys
 
 import pytest
 
-from tests.conftest import run_in_cpu_mesh
+from tests.conftest import require_jax_shard_map, run_in_cpu_mesh
 from tpusim.sim.driver import SimDriver, simulate_trace
 from tpusim.sim.stats import EXIT_SENTINEL
 from tpusim.timing.config import SimConfig
@@ -122,6 +122,7 @@ print("OK")
 
 @pytest.mark.slow
 def test_ring_attention_trace_has_ppermute(tmp_path):
+    require_jax_shard_map()
     out = tmp_path / "ring_trace"
     run_in_cpu_mesh(
         RING_CAPTURE_SCRIPT.replace("sys.argv[1]", repr(str(out))),

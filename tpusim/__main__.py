@@ -653,12 +653,79 @@ def _cmd_advise(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve_front(args: argparse.Namespace) -> int:
+    """serve v3: the multi-acceptor front tier — N acceptor processes
+    sharing the listen port via SO_REUSEPORT (fd-passing fallback),
+    each with its own HTTP parse + admission, against shared hot-cache
+    / disk-cache / quarantine state."""
+    import tempfile
+
+    from tpusim.serve.front import FrontSupervisor, reuse_port_available
+
+    ephemeral_quarantine = None
+    if args.state_dir:
+        quarantine_dir = str(Path(args.state_dir) / "quarantine")
+    else:
+        # no state dir: the shared quarantine is run-scoped — reclaim
+        # it after the drain or restarts would litter /tmp forever
+        ephemeral_quarantine = tempfile.mkdtemp(
+            prefix="tpusim-serve-quar-"
+        )
+        quarantine_dir = ephemeral_quarantine
+    settings = {
+        "trace_root": args.trace_root,
+        "max_inflight": args.max_inflight,
+        "queue_depth": args.queue_depth,
+        "deadline_s": args.deadline_s,
+        "max_request_bytes": args.max_request_bytes,
+        "result_cache": args.result_cache,
+        "workers": args.workers or 1,
+        "workers_per_acceptor": args.serve_workers,
+        "min_workers": args.serve_min_workers,
+        "job_workers": max(args.job_workers, 1),
+        "drain_grace_s": args.drain_grace_s,
+        "state_dir": args.state_dir,
+        "verbose": args.verbose,
+        "disk_quota": args.cache_quota,
+        "max_rss": args.max_rss,
+        "max_worker_rss": args.max_worker_rss,
+        "hot_cache": args.hot_cache,
+        "quarantine_dir": quarantine_dir,
+    }
+    front = FrontSupervisor(
+        settings, num_acceptors=args.acceptors,
+        host=args.host, port=args.port,
+    )
+    try:
+        front.start()
+    except (OSError, RuntimeError, ValueError) as e:
+        print(f"tpusim serve: error: {e}", file=sys.stderr)
+        return 2
+    front.install_signal_handlers()
+    mode = "SO_REUSEPORT" if reuse_port_available() else "fd-passing"
+    hot_note = ", hot-cache on" if args.hot_cache else ""
+    print(f"tpusim serve: listening on http://{front.host}:{front.port} "
+          f"(traces: {args.trace_root or 'inline only'}; "
+          f"acceptors {args.acceptors} via {mode}"
+          f"{hot_note})",
+          flush=True)
+    front.wait_stopped()
+    if ephemeral_quarantine is not None:
+        import shutil
+
+        shutil.rmtree(ephemeral_quarantine, ignore_errors=True)
+    print("tpusim serve: drained, exiting", flush=True)
+    return 0
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     """Long-running simulation service (tpusim.serve): JSON API over
     HTTP with hot traces, admission control, a process-wide shared
     engine-result cache, and SIGTERM drain."""
     from tpusim.serve.daemon import ServeDaemon
 
+    if args.acceptors and args.acceptors > 0:
+        return _cmd_serve_front(args)
     try:
         daemon = ServeDaemon(
             trace_root=args.trace_root,
@@ -682,6 +749,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             cache_quota=args.cache_quota,
             max_rss=args.max_rss,
             max_worker_rss=args.max_worker_rss,
+            hot_cache=args.hot_cache,
         )
     except ValueError as e:
         # a quota/size typo must refuse loudly, not bound nothing
@@ -711,7 +779,8 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
     target concurrency, report p50/p95/p99 + throughput, and compare the
     warm served path against the cold one-shot CLI."""
     from tpusim.serve.bench import (
-        format_report, format_sweep, run_serve_bench, run_worker_sweep,
+        format_acceptor_sweep, format_report, format_sweep,
+        run_acceptor_sweep, run_serve_bench, run_worker_sweep,
     )
 
     mix = None
@@ -720,7 +789,29 @@ def _cmd_serve_bench(args: argparse.Namespace) -> int:
             {"trace": t, "arch": args.arch}
             for t in args.trace
         ]
-    if args.worker_sweep:
+    if args.acceptor_sweep:
+        try:
+            counts = [int(c) for c in args.acceptor_sweep.split(",") if c]
+        except ValueError:
+            print(f"tpusim serve-bench: --acceptor-sweep wants a comma-"
+                  f"separated int list, got {args.acceptor_sweep!r}")
+            return 2
+        doc = run_acceptor_sweep(
+            acceptor_counts=counts,
+            trace_root=args.trace_root,
+            concurrency=args.concurrency,
+            requests=args.requests,
+            mix=mix,
+            hot_cache=not args.no_hot_cache,
+            serve_workers=args.serve_workers,
+            reps=args.reps,
+            loadgen_procs=args.loadgen_procs,
+        )
+        print(format_acceptor_sweep(doc))
+        failed = any(
+            leg["error_count"] for leg in doc["acceptor_sweep"]
+        )
+    elif args.worker_sweep:
         try:
             counts = [int(c) for c in args.worker_sweep.split(",") if c]
         except ValueError:
@@ -1504,6 +1595,21 @@ def main(argv: list[str] | None = None) -> int:
                      help="per-worker RSS cap (serve-workers mode): an "
                           "over-budget idle worker is restarted "
                           "deliberately between requests")
+    psv.add_argument("--acceptors", type=int, default=0, metavar="N",
+                     help="serve v3: N acceptor processes sharing the "
+                          "listen port via SO_REUSEPORT (fd-passing "
+                          "fallback; TPUSIM_NO_REUSEPORT=1 forces it) — "
+                          "each runs its own HTTP parse + admission, so "
+                          "no single GIL touches every request "
+                          "(default 0: one daemon process)")
+    psv.add_argument("--hot-cache", nargs="?", const=True, default=None,
+                     metavar="DIR",
+                     help="serve v3: shared mmap hot-response cache "
+                          "(default dir .tpusim_hot/) — warm repeat "
+                          "simulate requests are answered straight "
+                          "from the map: no dispatch, no re-pricing, "
+                          "no re-serialization; invalidated by model/"
+                          "format/tuned-overlay changes")
     psv.add_argument("--verbose", action="store_true",
                      help="per-request access log on stderr")
     psv.set_defaults(fn=_cmd_serve)
@@ -1537,6 +1643,20 @@ def main(argv: list[str] | None = None) -> int:
                      help="scaling curve: one warm bench leg per worker "
                           "count (0 = single-process baseline), e.g. "
                           "'0,1,2,4'; overrides --url/--serve-workers")
+    psb.add_argument("--acceptor-sweep", default=None, metavar="N,N,...",
+                     help="serve v3 scaling curve: one warm leg per "
+                          "acceptor count against an out-of-process "
+                          "front fleet (0 = single-process baseline "
+                          "added automatically), e.g. '1,2,4'; the "
+                          "loadgen fans over processes so its GIL "
+                          "never caps the measurement")
+    psb.add_argument("--no-hot-cache", action="store_true",
+                     help="acceptor-sweep legs WITHOUT the shared mmap "
+                          "hot-response cache (default: on)")
+    psb.add_argument("--loadgen-procs", type=int, default=None,
+                     metavar="N",
+                     help="loadgen processes for --acceptor-sweep "
+                          "(default: min(cores, 4), at least 2)")
     psb.add_argument("--reps", type=int, default=3, metavar="N",
                      help="measured storms per leg; each leg reports its "
                           "best-throughput pass (noisy-neighbor armor; "

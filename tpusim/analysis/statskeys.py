@@ -124,6 +124,19 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
 #: "faults" lane — one name, one meaning, two surfaces.
 SHARED_KEYS: dict[str, tuple[str, ...]] = {
     "faults_active": ("tpusim/faults", "tpusim/obs", "tpusim/sim"),
+    # serve v3's hot-response tier folds a cold response's per-request
+    # cache accounting to its warm form (every get that missed cold
+    # hits on replay), so the serving layer must name the exact pair
+    # the driver stamps; the CLI's profile summary prints the same two
+    # keys — one name, one meaning, more surfaces
+    "cache_hits": (
+        "tpusim/perf", "tpusim/sim", "tpusim/serve",
+        "tpusim/__main__.py",
+    ),
+    "cache_misses": (
+        "tpusim/perf", "tpusim/sim", "tpusim/serve",
+        "tpusim/__main__.py",
+    ),
 }
 
 #: prefixes `StatsRegistry.update(..., prefix=...)` may inject; "" is the

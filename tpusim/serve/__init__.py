@@ -36,6 +36,8 @@ from tpusim.serve.admission import (
 )
 from tpusim.serve.client import ServeClient, ServeError
 from tpusim.serve.daemon import SERVE_FORMAT_VERSION, ServeDaemon
+from tpusim.serve.front import FrontSupervisor
+from tpusim.serve.hotcache import HotResponseCache
 from tpusim.serve.registry import TraceRegistry
 from tpusim.serve.supervisor import Supervisor, WorkerTimeout
 from tpusim.serve.worker import RequestError, ServeWorker
@@ -45,6 +47,8 @@ __all__ = [
     "DeadlineExceeded",
     "Degraded",
     "Draining",
+    "FrontSupervisor",
+    "HotResponseCache",
     "JobTable",
     "Overloaded",
     "RequestError",

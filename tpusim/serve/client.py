@@ -18,8 +18,18 @@ constructor default, overridable per call via ``timeout_s=``) so a
 stalled daemon can never block the client forever, and **safe**
 failures — an idempotent GET, or a POST whose bytes never finished
 sending — retry with exponential backoff plus deterministic jitter.  A
-POST that finished sending is never replayed: the server may have
-executed it, and a re-sent ``/v1/sweep`` would enqueue a duplicate job.
+non-idempotent POST that finished sending is never replayed: the server
+may have executed it, and a re-sent ``/v1/sweep`` would enqueue a
+duplicate job.
+
+serve v3 extends the safe set to **idempotent POSTs**: ``/v1/simulate``
+and ``/v1/lint`` are pure functions of their body (pricing mutates
+nothing), so a connection reset by a recycled acceptor — the multi-
+acceptor front SIGKILLs and respawns acceptors under chaos — retries
+them transparently on a fresh connection, which the kernel routes to a
+surviving acceptor.  ``DELETE /v1/jobs/<id>`` (cancel) is idempotent by
+contract (cancelling twice changes nothing) and retries too.  Job
+SUBMISSIONS stay never-replayed.
 """
 
 from __future__ import annotations
@@ -178,7 +188,7 @@ class ServeClient:
 
     def _raw(
         self, method: str, path: str, body: dict | None = None,
-        timeout_s: float | None = None,
+        timeout_s: float | None = None, idempotent: bool = False,
     ):
         data = None
         headers = {"Accept": "application/json"}
@@ -216,7 +226,20 @@ class ServeClient:
                 conn.close()
                 self._local.conn = None
                 fresh = True
-                retryable = method == "GET" or not sent
+                # idempotent covers simulate/lint POSTs and cancel
+                # DELETEs: re-executing them changes nothing server-
+                # side, so a connection reset from a recycled acceptor
+                # (serve v3 restarts acceptors under it) is retried
+                # like any GET — unlike a job submission, which is
+                # never replayed once its bytes finished sending.  A
+                # TIMEOUT is different even for idempotent bodies: the
+                # server may still be executing the slow request, and
+                # stacking a replay behind it only compounds the load.
+                retryable = (
+                    method == "GET"
+                    or not sent
+                    or (idempotent and not isinstance(e, TimeoutError))
+                )
                 if (
                     retryable and was_cached and stale_budget > 0
                     and not isinstance(e, TimeoutError)
@@ -237,9 +260,11 @@ class ServeClient:
 
     def _request(
         self, method: str, path: str, body: dict | None = None,
-        timeout_s: float | None = None,
+        timeout_s: float | None = None, idempotent: bool = False,
     ) -> dict:
-        resp, payload = self._raw(method, path, body, timeout_s=timeout_s)
+        resp, payload = self._raw(
+            method, path, body, timeout_s=timeout_s, idempotent=idempotent,
+        )
         try:
             doc = json.loads(payload or b"{}")
         except (json.JSONDecodeError, ValueError):
@@ -301,6 +326,7 @@ class ServeClient:
             body["deadline_ms"] = deadline_ms
         doc = self._request(
             "POST", "/v1/simulate", body, timeout_s=timeout_s,
+            idempotent=True,
         )
         return SimResult(
             stats=doc["stats"],
@@ -335,7 +361,9 @@ class ServeClient:
             body["overlays"] = overlays
         if faults is not None:
             body["faults"] = faults
-        doc = self._request("POST", "/v1/lint", body, timeout_s=timeout_s)
+        doc = self._request(
+            "POST", "/v1/lint", body, timeout_s=timeout_s, idempotent=True,
+        )
         return LintReport(
             summary=str(doc["summary"]),
             errors=int(doc["errors"]),
@@ -394,6 +422,7 @@ class ServeClient:
         for ``--resume``).  Returns the job's reported status."""
         doc = self._request(
             "DELETE", f"/v1/jobs/{job_id}", timeout_s=timeout_s,
+            idempotent=True,
         )
         return str(doc["status"])
 

@@ -40,6 +40,22 @@ requests and accepted jobs run to completion, flush the disk tier of
 the shared result cache, close the listener, exit 0.  ``/healthz``
 reports 503 from the first drain instant so load balancers stop
 routing before the listener disappears.
+
+serve v3 (the multi-acceptor front tier, :mod:`tpusim.serve.front`):
+one ``ServeDaemon`` per **acceptor process**, each parsing + admitting
+on its own GIL.  Three additions engage only in that topology (or when
+``hot_cache`` is mounted standalone):
+
+* the **hot-response path**: a ``POST /v1/simulate`` whose affinity key
+  is published in the shared :class:`~tpusim.serve.hotcache.
+  HotResponseCache` is answered straight from the mmap — no admission,
+  no dispatch, no re-serialization (the stored bytes ARE the final
+  envelope, ``cache_hit`` true);
+* a **direct listener** on an ephemeral port for fleet-internal traffic
+  (peer ``/-/stats`` merges, job proxying to the primary acceptor);
+* **fleet views**: ``/metrics`` and ``/healthz`` merge every live
+  acceptor's local values into one document (``?scope=local`` keeps a
+  single acceptor's view reachable).
 """
 
 from __future__ import annotations
@@ -121,9 +137,11 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client went away; the work is done either way
         d._count_status(status)
 
-    def _send_body(self, status: int, body: bytes) -> None:
-        """Pre-serialized JSON body (a supervised worker's ok_bytes
-        response — already carries the format/model_version envelope)."""
+    def _send_body(self, status: int, body) -> None:
+        """Pre-serialized JSON body: a supervised worker's ok_bytes
+        response, or a hot-cache ``memoryview`` — both already carry the
+        format/model_version envelope.  A memoryview goes to the socket
+        without an intermediate copy (the serve v3 zero-copy path)."""
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -191,36 +209,39 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - stdlib signature
         d = self.daemon_obj
-        d._count("serve_requests_total")
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
+        local = "scope=local" in query
+        # fleet-internal probes (/-/stats, ?scope=local merges) are not
+        # traffic: counting them would inflate the fleet-summed request
+        # counters by N-1 on every scrape/health poll
+        if path != "/-/stats" and not local:
+            d._count("serve_requests_total")
         if path == "/healthz":
             if d.admission.draining:
                 self._send_json(503, {"status": "draining"})
+            elif d.in_fleet and not local:
+                self._send_json(200, d.fleet_healthz())
             else:
-                doc = {
-                    "status": "ok",
-                    "uptime_s": round(time.monotonic() - d._clock0, 3),
-                    **{f"admission_{k}": v
-                       for k, v in d.admission.stats_dict().items()},
-                }
-                sup = d.supervisor
-                if sup is not None:
-                    alive = sup.alive_count()
-                    # degraded is a STATE, not an outage: the daemon
-                    # still answers (shedding), so /healthz stays 200
-                    # and balancers read the field, not the status code
-                    if alive < sup.min_live:
-                        doc["status"] = "degraded"
-                    doc["workers_alive"] = alive
-                    doc["workers_configured"] = sup.num_workers
-                    doc["workers"] = sup.worker_docs()
-                self._send_json(200, doc)
+                self._send_json(200, d.local_healthz())
         elif path == "/metrics":
             d._count("serve_requests_metrics_total")
-            self._send_text(200, d.metrics_text(), "text/plain; version=0.0.4")
+            text = (
+                d.fleet_metrics_text()
+                if d.in_fleet and not local else d.metrics_text()
+            )
+            self._send_text(200, text, "text/plain; version=0.0.4")
+        elif path == "/-/stats":
+            # fleet-internal: this acceptor's raw metric values as JSON
+            # (the peer merging /metrics sums these; JSON beats parsing
+            # prometheus text back apart)
+            self._send_json(200, {"values": d.metrics_values()})
         elif path == "/v1/traces":
             self._send_json(200, {"traces": d.registry.names()})
         elif path.startswith("/v1/jobs/"):
+            if not d.is_primary:
+                self._proxy_to_primary("GET", path, None)
+                return
             job = d.jobs.get(path.rsplit("/", 1)[1])
             if job is None:
                 self._send_json(404, {
@@ -240,13 +261,78 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/v1/simulate":
             d._count("serve_requests_simulate_total")
-            self._run_sync("simulate", d.worker.simulate)
+            body = self._read_body()
+            if body is None:
+                return
+            # serve v3 hot path: a request whose exact response bytes
+            # are already published in the shared mmap tier is answered
+            # HERE — no admission slot, no dispatch, no re-pricing, no
+            # serialization.  The stored body is the final envelope a
+            # warm priced request would produce (cache_hit true), so
+            # clients cannot tell the tiers apart except by latency.
+            # deadline_ms is stripped from the hot key (volatile), so
+            # a MALFORMED one must be rejected before the hot lookup —
+            # the cold path 400s it, and the tiers must be
+            # indistinguishable except by latency
+            deadline_ok = True
+            if body.get("deadline_ms") is not None:
+                try:
+                    float(body["deadline_ms"])
+                except (TypeError, ValueError):
+                    deadline_ok = False
+            hot_key = (
+                d.hot_key_for("simulate", body) if deadline_ok else None
+            )
+            if hot_key is not None and not d.admission.draining:
+                blob = d.hot.get(hot_key)
+                if blob is not None:
+                    # serve_hot_hits_total rides /metrics from the hot
+                    # store's own counters — not double-counted here
+                    self._send_body(200, blob)
+                    return
+            self._run_sync(
+                "simulate", d.worker.simulate, body=body, hot_key=hot_key,
+            )
         elif path == "/v1/lint":
             d._count("serve_requests_lint_total")
             self._run_sync("lint", d.worker.lint)
         elif path in ("/v1/sweep", "/v1/campaign", "/v1/advise"):
             kind = path.rsplit("/", 1)[1]
-            d._count(f"serve_requests_{kind}_total")
+            if d.is_primary:
+                # secondaries skip the per-kind counter: the primary
+                # counts the forwarded copy, and fleet metrics sum
+                d._count(f"serve_requests_{kind}_total")
+            if not d.is_primary:
+                # serve v3: exactly one acceptor (the primary) owns the
+                # JobTable — async job ids, persistence, and restart
+                # recovery stay single-writer.  Secondaries forward the
+                # raw request over loopback to its direct listener.
+                # The size cap applies BEFORE the body is read, exactly
+                # like the local path's _read_body (including its 411
+                # on an unparseable length).
+                try:
+                    length = int(
+                        self.headers.get("Content-Length", "0") or 0
+                    )
+                except ValueError:
+                    self._send_json(411, {
+                        "error": "length_required",
+                        "detail": "Content-Length is required",
+                    })
+                    return
+                if length > d.max_request_bytes:
+                    self.close_connection = True
+                    self._send_json(413, {
+                        "error": "request_too_large",
+                        "detail": (
+                            f"body is {length} bytes; this server caps "
+                            f"requests at {d.max_request_bytes}"
+                        ),
+                    }, headers={"Connection": "close"})
+                    return
+                raw = self.rfile.read(length) if length > 0 else b""
+                self._proxy_to_primary("POST", path, raw)
+                return
             body = self._read_body()
             if body is None:
                 return
@@ -290,6 +376,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "error": "unknown_route", "detail": f"no route {path!r}",
             })
             return
+        if not d.is_primary:
+            self._proxy_to_primary("DELETE", path, None)
+            return
         job_id = path.rsplit("/", 1)[1]
         status = d.jobs.cancel(job_id)
         if status is None:
@@ -302,10 +391,59 @@ class _Handler(BaseHTTPRequestHandler):
             d._count("serve_jobs_cancel_requests_total")
         self._send_json(200, {"job_id": job_id, "status": status})
 
-    def _run_sync(self, endpoint: str, fn) -> None:
+    def _proxy_to_primary(self, method: str, path: str, raw) -> None:
+        """Forward one job-family request to the primary acceptor's
+        direct listener (serve v3: the JobTable is single-owner).  The
+        primary's response travels back verbatim."""
+        import http.client
+
+        d = self.daemon_obj
+        # the request was already counted at route entry, and the
+        # primary will count the forwarded copy when it handles it —
+        # without this compensation every proxied job request would
+        # show as TWO requests in the fleet-summed /metrics
+        d._count("serve_requests_total", -1.0)
+        target = d.primary_direct
+        if target is None:
+            d._count("serve_proxy_unavailable_total")
+            self._send_json(503, {
+                "error": "primary_unavailable",
+                "detail": (
+                    "the primary acceptor (job owner) is restarting; "
+                    "retry shortly"
+                ),
+            }, headers={"Retry-After": 1})
+            return
+        try:
+            conn = http.client.HTTPConnection(d.host, target, timeout=30.0)
+            headers = {"Accept": "application/json"}
+            if raw:
+                headers["Content-Type"] = "application/json"
+            conn.request(method, path, body=raw or None, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            conn.close()
+        except (OSError, http.client.HTTPException):
+            d._count("serve_proxy_unavailable_total")
+            self._send_json(503, {
+                "error": "primary_unavailable",
+                "detail": (
+                    "the primary acceptor (job owner) did not answer; "
+                    "retry shortly"
+                ),
+            }, headers={"Retry-After": 1})
+            return
+        d._count("serve_proxied_total")
+        self._send_body(resp.status, payload)
+
+    def _run_sync(
+        self, endpoint: str, fn, body: dict | None = None,
+        hot_key: str | None = None,
+    ) -> None:
         """Admission-gated execution of one synchronous endpoint."""
         d = self.daemon_obj
-        body = self._read_body()
+        if body is None:
+            body = self._read_body()
         if body is None:
             return
         budget_s = d.deadline_s
@@ -430,6 +568,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_body(200, bytes(result))
         else:
             self._send_json(200, result)
+        if hot_key is not None:
+            # publish AFTER answering: the requester never waits on the
+            # (one-time) warm-form serialization + fsync'd append
+            d.hot_publish(hot_key, result)
 
 
 class ServeDaemon:
@@ -460,6 +602,15 @@ class ServeDaemon:
         cache_quota=None,
         max_rss=None,
         max_worker_rss=None,
+        hot_cache=None,
+        hot_quota_bytes=None,
+        acceptor_index: int | None = None,
+        acceptors_total: int = 0,
+        reuse_port: bool = False,
+        public_listener: bool = True,
+        quarantine_dir=None,
+        close_fds=(),
+        worker_close_fds=(),
     ):
         from pathlib import Path
 
@@ -473,6 +624,29 @@ class ServeDaemon:
         self.drain_grace_s = float(drain_grace_s)
         self.verbose = bool(verbose)
         self.work_hook = work_hook
+        # serve v3 front-tier identity: None = standalone daemon (the
+        # PR 5/9 topologies, unchanged); an int = this process is
+        # acceptor <index> of a FrontSupervisor fleet.  Acceptor 0 is
+        # the primary (sole owner of the async JobTable); the rest
+        # proxy job-family routes to its direct listener.
+        self.acceptor_index = acceptor_index
+        self.acceptors_total = max(int(acceptors_total), 0)
+        self.in_fleet = acceptor_index is not None
+        self.is_primary = acceptor_index in (None, 0)
+        self.reuse_port = bool(reuse_port)
+        self.public_listener = bool(public_listener)
+        self._close_fds = tuple(close_fds or ())
+        # fds this daemon needs but its forked WORKERS must close (an
+        # acceptor's control pipe and fd-passing socket: a worker
+        # holding them open would keep a dead acceptor's channels
+        # half-alive — the front parent would ship connections into a
+        # socketpair nobody drains)
+        self._worker_close_fds = tuple(worker_close_fds or ())
+        # peer map (acceptor index -> direct port), pushed by the front
+        # supervisor after boot and on membership changes
+        self._peers: dict[int, int] = {}
+        self.primary_direct: int | None = None
+        self._peer_lock = threading.Lock()
 
         # the process-wide shared result cache: always at least the
         # in-memory tier (sharing across requests IS the service's
@@ -493,6 +667,33 @@ class ServeDaemon:
         self.worker = ServeWorker(
             self.registry, result_cache=self.result_cache, workers=workers,
         )
+        # serve v3: the shared mmap hot-response cache.  Keyed by the
+        # supervisor's content-hash affinity identity + a per-trace
+        # stat fingerprint; generation-stamped with model_version /
+        # format version / tuned-overlay state so staleness is
+        # structurally impossible (a bump orphans the files).
+        self.hot = None
+        if hot_cache:
+            from tpusim.serve.hotcache import (
+                HotResponseCache, hot_generation,
+            )
+
+            hot_dir = (
+                hot_cache if isinstance(hot_cache, (str, Path))
+                else ".tpusim_hot"  # the --result-cache default idiom
+            )
+            self.hot = HotResponseCache(
+                hot_dir,
+                generation=hot_generation(
+                    self.worker.model_version, SERVE_FORMAT_VERSION,
+                ),
+                **(
+                    {"quota_bytes": int(hot_quota_bytes)}
+                    if hot_quota_bytes else {}
+                ),
+            )
+        self._trace_fp_cache: dict[str, str] = {}
+        self._trace_fp_lock = threading.Lock()
         # serve v2: serve_workers >= 1 mounts the supervised pre-forked
         # worker pool — sync pricing (simulate/lint) moves into N
         # crash-isolated processes behind the admission layer, each with
@@ -520,6 +721,9 @@ class ServeDaemon:
                 min_live=min_workers,
                 restart_backoff_s=restart_backoff_s,
                 max_worker_rss_bytes=parse_size(max_worker_rss),
+                # serve v3: a shared quarantine dir makes poison
+                # refusal fleet-wide across acceptors
+                quarantine_dir=quarantine_dir,
             )
             if self.result_cache.disk_dir is not None:
                 # the parent still publishes to the shared dir (async
@@ -569,6 +773,8 @@ class ServeDaemon:
         self._guard_startup: dict[str, float] = {}
 
         self._httpd: ThreadingHTTPServer | None = None
+        self._direct_httpd: ThreadingHTTPServer | None = None
+        self._direct_thread: threading.Thread | None = None
         self._serve_thread: threading.Thread | None = None
         self._job_threads: list[threading.Thread] = []
         # 0 is a legitimate (test-facing) value: accept + persist jobs
@@ -594,16 +800,17 @@ class ServeDaemon:
         )
         self._count(bucket)
 
-    def metrics_text(self) -> str:
-        """The ``/metrics`` document — every serve counter plus the
-        admission/job/registry/cache gauges, in Prometheus exposition
-        format via the hardened :func:`~tpusim.obs.export.
-        prometheus_text`."""
-        from tpusim.obs.export import prometheus_text
-
+    def metrics_values(self) -> dict[str, float]:
+        """This process's raw metric values — the assembly half of
+        ``/metrics``, also served as JSON on the fleet-internal
+        ``/-/stats`` route so peer acceptors can merge without parsing
+        Prometheus text back apart."""
         with self._counter_lock:
             values = dict(self._counters)
         values["serve_uptime_s"] = time.monotonic() - self._clock0
+        if self.hot is not None:
+            for k, v in self.hot.stats_dict().items():
+                values[f"serve_{k}"] = v
         for k, v in self.admission.stats_dict().items():
             values[f"serve_admission_{k}"] = v
         for k, v in self.jobs.stats_dict().items():
@@ -629,6 +836,12 @@ class ServeDaemon:
                 values[f"guard_{k}"] = v
         for k, v in self._guard_startup.items():
             values[f"guard_{k}"] = v
+        return values
+
+    @staticmethod
+    def _render_metrics(values: dict[str, float]) -> str:
+        from tpusim.obs.export import prometheus_text
+
         return prometheus_text(
             values,
             help_text={
@@ -636,6 +849,257 @@ class ServeDaemon:
                 "serve_uptime_s": "seconds since daemon start",
             },
         )
+
+    def metrics_text(self) -> str:
+        """The ``/metrics`` document — every serve counter plus the
+        admission/job/registry/cache gauges, in Prometheus exposition
+        format via the hardened :func:`~tpusim.obs.export.
+        prometheus_text`."""
+        return self._render_metrics(self.metrics_values())
+
+    # -- fleet views (serve v3) ----------------------------------------------
+
+    def set_peers(
+        self, peers: dict[int, int], primary_direct: int | None,
+    ) -> None:
+        """Membership push from the front supervisor: acceptor index →
+        direct port, plus the primary's direct port (job proxy target)."""
+        with self._peer_lock:
+            self._peers = {int(k): int(v) for k, v in peers.items()}
+            self.primary_direct = primary_direct
+
+    def _peer_ports(self) -> list[tuple[int, int]]:
+        with self._peer_lock:
+            return sorted(
+                (i, p) for i, p in self._peers.items()
+                if i != self.acceptor_index
+            )
+
+    def _fetch_peer_json(self, port: int, path: str) -> dict | None:
+        import http.client
+        import json as _json
+
+        try:
+            # sub-second timeout: a health probe must not stack peer
+            # waits past a balancer's own check timeout
+            conn = http.client.HTTPConnection(self.host, port, timeout=0.8)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            payload = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return None
+            return _json.loads(payload)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+
+    def _fetch_peers_json(self, path: str) -> dict[int, dict | None]:
+        """All peers' ``path`` docs, fetched CONCURRENTLY — N-1
+        sequential timeouts against down peers would turn a partial
+        outage into a failed health check on the healthy acceptors."""
+        peers = self._peer_ports()
+        results: dict[int, dict | None] = {}
+        if not peers:
+            return results
+
+        def fetch(idx, port):
+            results[idx] = self._fetch_peer_json(port, path)
+
+        threads = [
+            threading.Thread(target=fetch, args=(i, p), daemon=True)
+            for i, p in peers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=2.0)
+        return {i: results.get(i) for i, _p in peers}
+
+    #: fleet-merge keys that describe ONE shared resource (the hot
+    #: store every acceptor mounts): summing N identical views would
+    #: report N× the real state, so these take the max instead
+    _FLEET_MAX_KEYS = frozenset({
+        "serve_uptime_s", "serve_hot_entries", "serve_hot_segment_bytes",
+    })
+
+    def fleet_metrics_text(self) -> str:
+        """One fleet view: every live acceptor's values merged —
+        counters/gauges sum (an N-acceptor fleet's inflight capacity IS
+        the sum of its admission bounds), while uptime and the shared
+        hot-store gauges take the max, and ``serve_acceptors_alive`` /
+        ``_configured`` describe the fleet."""
+        merged = self.metrics_values()
+        alive = 1
+        for _idx, doc in self._fetch_peers_json("/-/stats").items():
+            vals = (doc or {}).get("values")
+            if not isinstance(vals, dict):
+                continue
+            alive += 1
+            for k, v in vals.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if k in self._FLEET_MAX_KEYS:
+                    merged[k] = max(merged.get(k, 0.0), v)
+                else:
+                    merged[k] = merged.get(k, 0.0) + v
+        merged["serve_acceptors_alive"] = alive
+        merged["serve_acceptors_configured"] = self.acceptors_total
+        return self._render_metrics(merged)
+
+    def local_healthz(self) -> dict:
+        doc = {
+            "status": "ok",
+            "uptime_s": round(time.monotonic() - self._clock0, 3),
+            **{f"admission_{k}": v
+               for k, v in self.admission.stats_dict().items()},
+        }
+        if self.in_fleet:
+            import os as _os
+
+            doc["acceptor_index"] = self.acceptor_index
+            doc["pid"] = _os.getpid()
+            doc["direct_port"] = self.direct_port
+            doc["primary"] = self.is_primary
+        sup = self.supervisor
+        if sup is not None:
+            alive = sup.alive_count()
+            # degraded is a STATE, not an outage: the daemon still
+            # answers (shedding), so /healthz stays 200 and balancers
+            # read the field, not the status code
+            if alive < sup.min_live:
+                doc["status"] = "degraded"
+            doc["workers_alive"] = alive
+            doc["workers_configured"] = sup.num_workers
+            doc["workers"] = sup.worker_docs()
+        return doc
+
+    def fleet_healthz(self) -> dict:
+        """The merged ``/healthz``: this acceptor's local doc plus every
+        peer's (over their direct listeners), with one fleet verdict —
+        ``ok`` only when every configured acceptor answered ok."""
+        local = self.local_healthz()
+        acceptors = [local]
+        alive = 1
+        status = local["status"]
+        ports = dict(self._peer_ports())
+        for idx, peer in self._fetch_peers_json(
+            "/healthz?scope=local"
+        ).items():
+            if peer is None:
+                acceptors.append({
+                    "acceptor_index": idx, "status": "unreachable",
+                    "direct_port": ports.get(idx),
+                })
+                status = "degraded"
+                continue
+            alive += 1
+            acceptors.append(peer)
+            if peer.get("status") != "ok":
+                status = "degraded"
+        if self.acceptors_total and alive < self.acceptors_total:
+            status = "degraded"
+        return {
+            "status": status,
+            "acceptors_alive": alive,
+            "acceptors_configured": self.acceptors_total,
+            "acceptors": sorted(
+                acceptors, key=lambda a: a.get("acceptor_index", -1)
+            ),
+        }
+
+    # -- hot-response tier (serve v3) ----------------------------------------
+
+    def _trace_fingerprint(self, name: str) -> str | None:
+        """A cheap stat fingerprint of one named trace directory
+        (file names + sizes + mtimes), cached per name.  Joins the hot
+        key so a hot dir surviving a daemon restart can never serve
+        bytes priced from different on-disk trace content."""
+        with self._trace_fp_lock:
+            fp = self._trace_fp_cache.get(name)
+        if fp is not None:
+            return fp
+        root = self.registry.trace_root
+        if root is None:
+            return None
+        path = root / name
+        if not path.is_dir():
+            return None
+        import hashlib
+
+        parts = []
+        try:
+            for p in sorted(path.rglob("*")):
+                if p.is_file():
+                    st = p.stat()
+                    parts.append(
+                        f"{p.relative_to(path)}:{st.st_size}:"
+                        f"{st.st_mtime_ns}"
+                    )
+        except OSError:
+            return None
+        fp = hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+        with self._trace_fp_lock:
+            self._trace_fp_cache[name] = fp
+        return fp
+
+    def hot_key_for(self, endpoint: str, body: dict) -> str | None:
+        """The hot-cache identity of one request, or None when the
+        request is not hot-servable (no hot tier, or a named trace we
+        cannot fingerprint).  Built on the supervisor's affinity hash —
+        the same volatile-key stripping, so deadlines never fragment
+        the hot tier."""
+        if self.hot is None or not isinstance(body, dict):
+            return None
+        from tpusim.serve.supervisor import Supervisor
+
+        key = Supervisor.affinity_key(endpoint, body)
+        trace = body.get("trace")
+        if trace is not None:
+            fp = self._trace_fingerprint(str(trace))
+            if fp is None:
+                return None  # unknown trace: let the 404 path answer
+            key = f"{key}-{fp}"
+        return key
+
+    def hot_publish(self, hot_key: str, result) -> None:
+        """Publish one successful simulate response in WARM form: the
+        exact bytes a repeat (result-cache-hit) request would produce —
+        ``cache_hit`` true, the per-request cache accounting folded to
+        its steady state (every get that missed cold hits on replay).
+        First writer wins across acceptors; all produced byte-identical
+        pricing by the serving contract."""
+        import json as _json
+
+        try:
+            if isinstance(result, (bytes, bytearray, memoryview)):
+                doc = _json.loads(bytes(result))
+            else:
+                doc = {
+                    "format_version": SERVE_FORMAT_VERSION,
+                    "model_version": self.worker.model_version,
+                    **result,
+                }
+            if not doc.get("cache_hit", False):
+                doc = dict(doc)
+                doc["cache_hit"] = True
+                stats = doc.get("stats")
+                if isinstance(stats, dict) and "cache_misses" in stats:
+                    # fold the per-request accounting to its warm form,
+                    # PRESERVING numeric types — an int 0 and a float
+                    # 0.0 serialize differently, and these bytes must
+                    # equal a real warm response's exactly
+                    stats = dict(stats)
+                    misses = stats["cache_misses"]
+                    stats["cache_hits"] = (
+                        stats.get("cache_hits", 0) + misses
+                    )
+                    stats["cache_misses"] = type(misses)(0)
+                    doc["stats"] = stats
+            body = _json.dumps(doc, sort_keys=True).encode() + b"\n"
+            # publishes ride /metrics from the hot store's own counter
+            self.hot.publish(hot_key, body)
+        except (OSError, ValueError, TypeError):
+            self._count("serve_hot_publish_errors_total")
 
     # -- sync dispatch -------------------------------------------------------
 
@@ -659,8 +1123,24 @@ class ServeDaemon:
         return self._httpd.server_address[1]
 
     @property
+    def direct_port(self) -> int | None:
+        """The fleet-internal listener's port (serve v3; None when this
+        daemon is not an acceptor)."""
+        if self._direct_httpd is None:
+            return None
+        return self._direct_httpd.server_address[1]
+
+    @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    def inject_connection(self, sock, addr) -> None:
+        """Dispatch one already-accepted connection into this daemon's
+        HTTP stack — the fd-passing fallback path on kernels without
+        ``SO_REUSEPORT`` (the front parent accepts and ships the fd via
+        ``socket.send_fds``; this acceptor parses and serves it)."""
+        server = self._direct_httpd or self._httpd
+        server.process_request(sock, addr)
 
     def start(self) -> "ServeDaemon":
         """Bind the listener and start serving on background threads.
@@ -693,6 +1173,16 @@ class ServeDaemon:
                 )
         if self.watchdog is not None:
             self.watchdog.start()
+        import os as _os
+
+        for fd in self._close_fds:
+            # fds inherited from a front supervisor (its port-reserve
+            # socket, siblings' pipe ends): close them so a dead parent
+            # releases its resources regardless of acceptor lifetimes
+            try:
+                _os.close(int(fd))
+            except (OSError, ValueError, TypeError):
+                pass
         handler = type(
             "BoundHandler", (_Handler,), {"daemon_obj": self},
         )
@@ -704,23 +1194,56 @@ class ServeDaemon:
             # service time
             request_queue_size = 128
 
-        self._httpd = _Server(
-            (self.host, self._requested_port), handler,
-        )
-        self._httpd.daemon_threads = True
+        class _ReusePortServer(_Server):
+            # serve v3: N acceptor processes each bind their own
+            # listening socket on the SAME port; the kernel distributes
+            # incoming connections across the reuseport group — no
+            # single process ever parses every request
+            def server_bind(self):
+                import socket as _socket
+
+                self.socket.setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1,
+                )
+                super().server_bind()
+
+        self._httpd = None
+        if self.public_listener:
+            server_cls = _ReusePortServer if self.reuse_port else _Server
+            self._httpd = server_cls(
+                (self.host, self._requested_port), handler,
+            )
+            self._httpd.daemon_threads = True
+        self._direct_httpd = None
+        if self.in_fleet:
+            # the fleet-internal listener: peer /-/stats merges, job
+            # proxying to the primary, and (fd-passing fallback mode)
+            # the server object injected connections dispatch through
+            self._direct_httpd = _Server((self.host, 0), handler)
+            self._direct_httpd.daemon_threads = True
         if self.supervisor is not None:
-            # forked workers inherit the freshly-bound listener; they
-            # close it first thing (the fd travels via settings) so a
+            # forked workers inherit the freshly-bound listeners; they
+            # close them first thing (the fds travel via settings) so a
             # dead daemon's port is never held open by its workers
             self.supervisor.settings["inherited_fds"] = [
-                self._httpd.fileno()
-            ]
+                s.fileno() for s in (self._httpd, self._direct_httpd)
+                if s is not None
+            ] + [int(f) for f in self._worker_close_fds]
             self.supervisor.start()
-        self._serve_thread = threading.Thread(
-            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
-            name="tpusim-serve-accept", daemon=True,
-        )
-        self._serve_thread.start()
+        if self._httpd is not None:
+            self._serve_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="tpusim-serve-accept", daemon=True,
+            )
+            self._serve_thread.start()
+        if self._direct_httpd is not None:
+            self._direct_thread = threading.Thread(
+                target=self._direct_httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="tpusim-serve-direct", daemon=True,
+            )
+            self._direct_thread.start()
         for i in range(self._job_workers):
             t = threading.Thread(
                 target=self._job_loop, name=f"tpusim-serve-job-{i}",
@@ -802,9 +1325,10 @@ class ServeDaemon:
         flushed = self.result_cache.flush()
         if self.verbose and flushed:
             print(f"tpusim serve: drain flushed {flushed} cache records")
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        for srv in (self._httpd, self._direct_httpd):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
         self._stopped.set()
         return clean
 
@@ -822,9 +1346,10 @@ class ServeDaemon:
             # crash simulation still reaps the fleet: orphan workers
             # would hold the (inherited) state the next daemon needs
             self.supervisor.stop(grace_s=0.2)
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        for srv in (self._httpd, self._direct_httpd):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
         self._stopped.set()
 
     def wait_stopped(self, timeout_s: float | None = None) -> bool:

@@ -172,8 +172,26 @@ class Supervisor:
         restart_backoff_s: float = 0.05,
         spawn_timeout_s: float = 60.0,
         max_worker_rss_bytes: int | None = None,
+        quarantine_dir=None,
     ):
         self.settings = dict(settings)
+        # serve v3: a shared quarantine directory makes poison refusal
+        # FLEET-wide — every acceptor's supervisor publishes its poison
+        # verdicts as one atomic file per content hash, so a request
+        # that killed workers behind acceptor A is refused immediately
+        # by acceptor B instead of being allowed to kill B's workers too
+        from pathlib import Path
+
+        self.quarantine_dir = Path(quarantine_dir) if quarantine_dir else None
+        if self.quarantine_dir is not None:
+            self.quarantine_dir.mkdir(parents=True, exist_ok=True)
+        # negative-lookup cache for the shared dir: clean keys are the
+        # overwhelming majority, and an open()+ENOENT per dispatch
+        # forever would tax the hot path.  The dir's mtime moves when a
+        # peer publishes a verdict (rename into the dir), which is the
+        # invalidation signal.
+        self._quarantine_neg: set[str] = set()
+        self._quarantine_dir_mtime: int = -1
         # tpusim.guard: per-worker RSS cap.  The monitor samples each
         # idle worker's /proc RSS about once a second and restarts an
         # over-budget one DELIBERATELY between requests (commanded kill,
@@ -685,6 +703,9 @@ class Supervisor:
         key = self.affinity_key(endpoint, body)
         with self._lock:
             poison = self._quarantine.get(key)
+        if poison is None and self.quarantine_dir is not None:
+            poison = self._quarantine_file_get(key)
+        with self._lock:
             if poison is None:
                 self.dispatched += 1
             else:
@@ -770,6 +791,50 @@ class Supervisor:
         }
         with self._lock:
             self._quarantine[key] = doc
+            while len(self._quarantine) > self.quarantine_max:
+                self._quarantine.pop(next(iter(self._quarantine)))
+        if self.quarantine_dir is not None:
+            # publish fleet-wide: one atomic file per content hash, so
+            # every OTHER acceptor's supervisor refuses this request
+            # without paying its own worker deaths first
+            try:
+                path = self.quarantine_dir / f"{key}.json"
+                tmp = path.with_suffix(f".tmp.{os.getpid()}")
+                tmp.write_text(json.dumps(doc, sort_keys=True))
+                os.replace(tmp, path)
+            except OSError:
+                pass  # local quarantine still holds
+        return doc
+
+    def _quarantine_file_get(self, key: str) -> dict | None:
+        """A peer acceptor's quarantine verdict for ``key`` (shared
+        dir), cached into the local LRU on first sight.  Negative
+        results are cached against the dir's mtime (a publish renames a
+        file into the dir, moving it) so clean traffic pays one stat,
+        not one failed open per dispatch."""
+        try:
+            dir_mtime = self.quarantine_dir.stat().st_mtime_ns
+        except OSError:
+            return None
+        with self._lock:
+            if dir_mtime != self._quarantine_dir_mtime:
+                self._quarantine_neg.clear()
+                self._quarantine_dir_mtime = dir_mtime
+            elif key in self._quarantine_neg:
+                return None
+        try:
+            doc = json.loads(
+                (self.quarantine_dir / f"{key}.json").read_text()
+            )
+        except (OSError, json.JSONDecodeError):
+            with self._lock:
+                if dir_mtime == self._quarantine_dir_mtime:
+                    self._quarantine_neg.add(key)
+            return None
+        if not isinstance(doc, dict):
+            return None
+        with self._lock:
+            self._quarantine.setdefault(key, doc)
             while len(self._quarantine) > self.quarantine_max:
                 self._quarantine.pop(next(iter(self._quarantine)))
         return doc

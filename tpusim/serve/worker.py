@@ -122,6 +122,10 @@ class ServeWorker:
         self.model_version = model_version()
         self._config_cache: dict[str, object] = {}
         self._config_lock = threading.Lock()
+        # requests priced by THIS worker object (the serve v3 front
+        # smoke's zero-dispatch proof: a pass served entirely from the
+        # hot mmap tier must leave this counter untouched)
+        self.priced = 0
         # cumulative async-job executor accounting (campaign_* and
         # advise_* namespaces), mirrored on /metrics
         self._job_totals: dict[str, float] = {}
@@ -284,6 +288,7 @@ class ServeWorker:
                 422, "replay_failed", f"{type(e).__name__}: {e}"
             )
         stats = json.loads(report.stats.to_json())
+        self.priced += 1
         return {
             "trace": entry.name,
             "arch": cfg.arch.name,
@@ -480,6 +485,7 @@ class ServeWorker:
                 out[f"cache_{k}"] = v
         with self._config_lock:
             out["configs_hot"] = len(self._config_cache)
+        out["priced_total"] = self.priced
         with self._job_lock:
             out.update(self._job_totals)
         return out
