@@ -621,6 +621,54 @@ def replay_fixture_errors(
     return out
 
 
+def _durable_cold_replay(
+    entries: list[dict], fixture_dir: Path, arch: str,
+) -> tuple[float, dict]:
+    """Wall seconds (best of 3) for the full cold composition — trace
+    load + pricing — against a warm durable compile store, plus the
+    store's counters.  Each trial clears the in-memory compiled tier
+    and reloads every trace (parse deferred), so only the disk columns
+    carry state between trials: this is what a fresh serve worker or
+    campaign process pays."""
+    import shutil
+    import tempfile
+
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace, select_module
+
+    cfg = load_config(arch=arch)
+    store_dir = tempfile.mkdtemp(prefix="tpusim-bench-cmod-")
+    try:
+        # populate: one pricing pass with the store mounted persists
+        # the columns the earlier passes already compiled in memory
+        set_compile_store(CompileStore(store_dir))
+        for entry in entries:
+            td = load_trace(fixture_dir / entry["trace"])
+            Engine(cfg).run(select_module(td, entry.get("module")))
+        best = None
+        stats: dict = {}
+        for _ in range(3):
+            clear_compiled_cache()
+            store = CompileStore(store_dir)
+            set_compile_store(store)
+            t0 = time.perf_counter()
+            for entry in entries:
+                td = load_trace(fixture_dir / entry["trace"])
+                Engine(cfg).run(select_module(td, entry.get("module")))
+            wall = time.perf_counter() - t0
+            if best is None or wall < best:
+                best = wall
+                stats = store.stats_dict()
+        return best, stats
+    finally:
+        set_compile_store(None)
+        clear_compiled_cache()
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     """Replay committed TPU traces against their committed measured times.
 
@@ -679,6 +727,22 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     )
     warm_wall = time.perf_counter() - warm_t0
     pricing_backend = resolve_backend(None)
+    # durable-cold pass: the fresh-process-with-a-warm-disk-tier regime
+    # the PR 12 compile store exists for.  Populate a throwaway store
+    # from the already-compiled modules, then replay the full cold
+    # composition (trace load INCLUDED, parse deferred) with the
+    # in-memory compiled tier cleared — pricing runs from mmapped
+    # columns with zero IR construction.  Best-of-3 (the serve-bench
+    # discipline: co-tenant noise halves absolutes in bad windows).
+    durable_wall = None
+    durable_stats = None
+    try:
+        durable_wall, durable_stats = _durable_cold_replay(
+            manifest.get("workloads", []), fixture_dir, arch,
+        )
+    except Exception as e:
+        log(f"bench(fixture): durable-cold leg FAILED: "
+            f"{type(e).__name__}: {e}")
     for name, sim_s, real_s, err, src, _fl, _hb, _ops in rows:
         # ground-truth provenance: entries captured before the
         # device-timeline change (or where the profiler failed) hold
@@ -727,6 +791,14 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
         "sim_rate_kops_cold": round(
             sum(r[7] for r in rows) / replay_wall / 1e3, 1
         ) if replay_wall > 0 and rows else None,
+        # the durable-tier cold rate: same composition as _cold (trace
+        # load + pricing, fresh in-memory state) but against a warm
+        # disk compile store — the first-touch rate a fleet process
+        # actually pays once any peer has compiled the module (PR 12)
+        "sim_rate_kops_cold_durable": round(
+            sum(r[7] for r in rows) / durable_wall / 1e3, 1
+        ) if durable_wall and rows else None,
+        "compile_store": durable_stats,
         # which tpusim.fastpath backend priced (serial/vectorized/native)
         "pricing_backend": pricing_backend,
         # simulator throughput + cache effectiveness ride the artifact

@@ -319,7 +319,7 @@ def lint_smoke() -> dict:
 #: stats the perf/guard layers add only when active — stripped before
 #: golden comparison (the determinism contract covers the simulation
 #: stats, not the layers' own accounting)
-PERF_KEY_PREFIXES = ("cache_", "pool_", "guard_")
+PERF_KEY_PREFIXES = ("cache_", "pool_", "guard_", "fastpath_")
 
 
 def perf_smoke() -> dict:
@@ -900,7 +900,13 @@ def fastpath_smoke() -> dict:
     3. a streaming leg re-runs the matrix with every module file-backed
        (``TPUSIM_STREAM_THRESHOLD=0``) and must match the committed
        goldens too — bounded-RSS pricing is not allowed to change a
-       single stat."""
+       single stat;
+    4. a durable leg persists compiled columns to a throwaway store,
+       clears the in-memory compiled tier, and re-runs the matrix
+       through DISK-loaded columns (traces reloaded with deferred
+       parsing): byte-identical to the goldens, zero recompiles, the
+       store provably hit.  (The cold-serve half of the tier lives in
+       :func:`cold_serve_smoke`.)"""
     import os
 
     from tpusim.fastpath import native_price_available, numpy_available
@@ -971,11 +977,187 @@ def fastpath_smoke() -> dict:
             "fastpath parity: streaming (file-backed) replay diverged "
             "from committed goldens:\n  " + "\n  ".join(errors)
         )
+
+    # durable leg (tpusim.fastpath.store): compiled columns persisted
+    # to a throwaway store must serve a fresh-process-equivalent replay
+    # (in-memory compiled tier cleared, traces reloaded with deferred
+    # parsing) byte-identically, with zero recompiles and the store
+    # provably hit
+    import shutil
+    import tempfile
+
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache, compiled_cache_stats
+
+    store_dir = tempfile.mkdtemp(prefix="tpusim-ci-cmod-")
+    try:
+        set_compile_store(CompileStore(store_dir))
+        run_matrix()  # populate: pricing persists columns post-walk
+        clear_compiled_cache()
+        store = CompileStore(store_dir)
+        set_compile_store(store)
+        misses_before = compiled_cache_stats()["compile_misses"]
+        disk_docs = {
+            name: {
+                k: v for k, v in doc.items()
+                if not k.startswith(PERF_KEY_PREFIXES)
+            }
+            for name, doc in run_matrix().items()
+        }
+        misses_after = compiled_cache_stats()["compile_misses"]
+        errors = compare(disk_docs)
+        if errors:
+            raise ValueError(
+                "fastpath parity: disk-loaded compiled replay diverged "
+                "from committed goldens:\n  " + "\n  ".join(errors)
+            )
+        if misses_after != misses_before:
+            raise ValueError(
+                f"durable pass recompiled "
+                f"{misses_after - misses_before} module(s) despite a "
+                f"warm compile store (expected 0)"
+            )
+        if store.hits <= 0:
+            raise ValueError(
+                "durable pass never hit the compile store"
+            )
+        store_hits = store.hits
+    finally:
+        set_compile_store(None)
+        clear_compiled_cache()
+        shutil.rmtree(store_dir, ignore_errors=True)
     return {
         "configs": len(serial_docs),
         "backends": backends,
         "streamed_configs": len(streamed),
+        "durable_configs": len(disk_docs),
+        "durable_store_hits": store_hits,
     }
+
+
+def cold_serve_smoke() -> dict:
+    """The durable tier's cold-path contract, end to end: a FRESH
+    daemon process booted against a warm disk compile store must price
+    its first request with **zero Python IR construction** — no parse,
+    no span index, no computation objects; just mmapped columns.
+
+    Proven over the process boundary via the stats the driver stamps
+    when the store is active: ``fastpath_ir_ops_built`` (the
+    process-wide op-construction counter) must be 0 and
+    ``fastpath_store_hits`` >= 1 on the response, and ``/metrics`` must
+    expose the compile-cache counters.  The first-request wall time is
+    reported (the ~660 ms -> <70 ms trajectory lives in BENCH/serve
+    bench artifacts; a CI container's absolute latency is not a
+    contract)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import time
+    import urllib.request
+
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache
+    from tpusim.sim.driver import simulate_trace
+
+    fixture, arch, _ = MATRIX[2]  # llama_tiny_tp2dp2 @ v5p (collectives)
+    store_dir = tempfile.mkdtemp(prefix="tpusim-ci-coldserve-")
+    proc = None
+    try:
+        store = CompileStore(store_dir)
+        set_compile_store(store)
+        try:
+            simulate_trace(FIXTURES / fixture, arch=arch, tuned=False)
+        finally:
+            set_compile_store(None)
+            clear_compiled_cache()
+        if store.stores <= 0:
+            raise ValueError("warm-up persisted no compiled records")
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpusim", "serve", "--port", "0",
+             "--trace-root", str(FIXTURES), "--compile-cache", store_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        # the bound-port line is the documented startup contract; a
+        # watchdog kills a daemon that hangs WITHOUT printing it —
+        # readline() alone would block past any deadline check
+        import threading
+
+        boot_watchdog = threading.Timer(60, proc.kill)
+        boot_watchdog.start()
+        port = None
+        try:
+            while True:
+                line = proc.stdout.readline()
+                if not line:
+                    raise ValueError(
+                        f"daemon exited (or was killed at the 60s boot "
+                        f"deadline) before binding (rc={proc.poll()})"
+                    )
+                if "listening on http://" in line:
+                    hostport = (
+                        line.split("listening on http://", 1)[1]
+                        .split()[0].rstrip("/")
+                    )
+                    port = int(hostport.rsplit(":", 1)[1])
+                    break
+        finally:
+            boot_watchdog.cancel()
+
+        body = json.dumps({
+            "trace": fixture, "arch": arch, "tuned": False,
+            "validate": False,
+        }).encode()
+        t0 = time.perf_counter()
+        resp = urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/simulate", data=body,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=120,
+        )
+        doc = json.loads(resp.read())
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        stats = doc.get("stats") or {}
+        built = stats.get("fastpath_ir_ops_built")
+        if built != 0:
+            raise ValueError(
+                f"cold first request built {built} IR op(s) despite a "
+                f"warm compile store (expected 0: the request must "
+                f"price from mmapped columns alone)"
+            )
+        if stats.get("fastpath_store_hits", 0) < 1:
+            raise ValueError(
+                "cold first request never hit the compile store "
+                f"(fastpath_store_hits="
+                f"{stats.get('fastpath_store_hits')})"
+            )
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ).read().decode()
+        for needle in ("fastpath_store_hits", "fastpath_compile_hits"):
+            if needle not in metrics:
+                raise ValueError(
+                    f"/metrics missing compile-cache counter {needle!r}"
+                )
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+        if proc.returncode != 0:
+            raise ValueError(
+                f"daemon drain exited rc={proc.returncode}"
+            )
+        proc = None
+        return {
+            "cold_first_request_ms": round(cold_ms, 1),
+            "store_records": store.stores,
+        }
+    finally:
+        if proc is not None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(store_dir, ignore_errors=True)
 
 
 #: --guard-smoke store quota: above the largest single matrix record
@@ -1492,11 +1674,22 @@ def main(argv: list[str] | None = None) -> int:
         except (ValueError, OSError, KeyError) as e:
             print(f"ci/check_golden --fastpath-parity: FAILED: {e}")
             return 1
+        try:
+            cold = cold_serve_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --fastpath-parity [cold-serve]: "
+                  f"FAILED: {e}")
+            return 1
         print(f"ci/check_golden --fastpath-parity: OK "
               f"({summary['configs']} configs byte-identical across "
               f"backends {summary['backends']}; "
               f"{summary['streamed_configs']} streamed configs match "
-              f"the committed goldens)")
+              f"the committed goldens; "
+              f"{summary['durable_configs']} disk-loaded configs match "
+              f"with {summary['durable_store_hits']} store hits and "
+              f"zero recompiles; cold-serve first request priced with "
+              f"zero IR construction in "
+              f"{cold['cold_first_request_ms']:.0f}ms)")
         return 0
 
     if args.advise_smoke:

@@ -34,7 +34,14 @@
 #                through the serial reference walk, the NumPy-vectorized
 #                fastpath, and (when built) the native kernel must be
 #                byte-identical and match the committed goldens; a
-#                streaming leg (every module file-backed) must match too
+#                streaming leg (every module file-backed) must match
+#                too; a durable leg (compiled columns persisted to a
+#                throwaway store, in-memory tier cleared, traces
+#                reloaded with deferred parsing) must match with zero
+#                recompiles; and a cold-serve smoke boots a FRESH
+#                daemon process against the warm store and requires
+#                its first request priced with zero Python IR
+#                construction (fastpath_ir_ops_built == 0)
 #  10. serve   — serving-layer determinism: boot the daemon on a free
 #                loopback port, replay the golden matrix over HTTP;
 #                served stats docs must be byte-identical to the
@@ -119,7 +126,7 @@ python ci/check_golden.py --lint-smoke
 echo "=== [8/16] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/16] fastpath parity (pricing-backend byte-identity) ==="
+echo "=== [9/16] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
 echo "=== [10/16] serve smoke (HTTP daemon determinism, 1..N workers) ==="

@@ -18,12 +18,27 @@
 //   (literal = raw paren content, emitted for "constant" ops only)
 // Control chars cannot appear in HLO text, so no escaping is needed.
 //
+// hlo_scan2 (parse-to-columns) emits the same record frame with two
+// fields upgraded so Python IR assembly runs no regex and no
+// balanced-delimiter splitting at all:
+//   * the shape field carries a pre-parsed token stream — ';'-joined
+//     prefix tokens, "(N" opening an N-part tuple and
+//     "dtype:dims:layout:tiling:space" per leaf (layout/tiling "n" when
+//     absent; dims/layout canonical comma-joined ints) — or, when a
+//     shape needs the reference parser (comments, exotic layouts), the
+//     raw text prefixed with '!' so Python falls back per shape with
+//     identical error semantics;
+//   * the attr field carries the top-level attr tokens pre-split and
+//     GS (0x1d)-joined — exactly split_top_level(raw_attr_text)'s
+//     non-empty stripped tokens.
+//
 // Build: make -C native   (produces libtpusim_native.so)
 
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -79,9 +94,235 @@ bool is_ident_char(char c) {
          (c >= '0' && c <= '9') || c == '_' || c == '.' || c == '-';
 }
 
+// ---------------------------------------------------------------------------
+// v2 shape encoding (parse-to-columns)
+// ---------------------------------------------------------------------------
+//
+// Mirrors tpusim/trace/hlo_text.py parse_shape exactly on the fast
+// path; anything the mirror cannot guarantee byte-for-byte falls back
+// to the reference parser per shape (the caller emits '!' + raw text).
+
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+inline const char* trim_span(const char*& p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  while (end > p && (end[-1] == ' ' || end[-1] == '\t')) --end;
+  return end;
+}
+
+// Parse a comma-separated int list ("8,128", entries padded with
+// blanks; empty entries skipped) into canonical comma-joined
+// decimals.  `allow_dyn` strips leading '<'/'=' chars per entry — the
+// dynamic-dim form the reference strips ONLY in dims
+// (`d.strip().lstrip("<=")` + int(d)); a layout minor list must NOT
+// accept it, or the mirror would parse text the reference rejects.
+// False when anything else appears.
+bool enc_int_list(const char* p, const char* end, std::string& out,
+                  int* count, bool allow_dyn) {
+  *count = 0;
+  while (p < end) {
+    const char* seg_end =
+        static_cast<const char*>(memchr(p, ',', end - p));
+    if (!seg_end) seg_end = end;
+    const char* s = p;
+    const char* e = trim_span(s, seg_end);
+    if (allow_dyn)
+      while (s < e && (*s == '<' || *s == '=')) ++s;
+    if (s < e) {
+      if (e - s > 18) return false;  // int64 overflow guard
+      long long v = 0;
+      for (const char* q = s; q < e; ++q) {
+        if (!is_digit(*q)) return false;
+        v = v * 10 + (*q - '0');
+      }
+      if (*count) out.push_back(',');
+      out += std::to_string(v);
+      ++(*count);
+    }
+    p = seg_end + 1;
+  }
+  return true;
+}
+
+// Encode one array leaf "dtype[dims]{layout}" (whole span, anchored).
+bool enc_leaf(const char* p, const char* end, std::string& out) {
+  const char* q = p;
+  if (q >= end || *q < 'a' || *q > 'z') return false;
+  ++q;
+  while (q < end &&
+         ((*q >= 'a' && *q <= 'z') || is_digit(*q)))
+    ++q;
+  out.append(p, q - p);  // dtype
+  out.push_back(':');
+  if (q < end && *q == '[') {
+    const char* close =
+        static_cast<const char*>(memchr(q, ']', end - q));
+    if (!close) return false;
+    int n = 0;
+    if (!enc_int_list(q + 1, close, out, &n, /*allow_dyn=*/true))
+      return false;
+    q = close + 1;
+  }
+  out.push_back(':');
+  std::string tiling = "n";
+  long long space = 0;
+  if (q < end && *q == '{') {
+    const char* close = find_match(q, end);
+    if (!close) return false;
+    const char* lay = q + 1;
+    const char* colon =
+        static_cast<const char*>(memchr(lay, ':', close - lay));
+    const char* minor_end = colon ? colon : close;
+    // minor list: layout None when (post-strip) empty, else canonical
+    // ints; a non-empty minor yielding zero entries (e.g. "{,}") is an
+    // empty-tuple layout the mirror refuses — reference parser decides
+    const char* ms = lay;
+    const char* me = trim_span(ms, minor_end);
+    if (ms < me) {
+      std::string minor;
+      int n = 0;
+      if (!enc_int_list(ms, me, minor, &n, /*allow_dyn=*/false))
+        return false;
+      if (n == 0) return false;
+      out += minor;
+    } else {
+      out.push_back('n');
+    }
+    if (colon) {
+      // extras: first T(...)+ run is the tiling, first S(d+) the space
+      const char* x = colon + 1;
+      for (const char* t = x; t + 1 < close; ++t) {
+        if (*t != 'T' || t[1] != '(') continue;
+        const char* g = t + 1;
+        const char* run_end = g;
+        while (run_end < close && *run_end == '(') {
+          const char* h = run_end + 1;
+          while (h < close && (is_digit(*h) || *h == ',')) ++h;
+          if (h >= close || *h != ')') break;
+          run_end = h + 1;
+        }
+        if (run_end > g) {
+          tiling.assign(g, run_end - g);
+          break;
+        }
+      }
+      for (const char* t = x; t + 1 < close; ++t) {
+        if (*t != 'S' || t[1] != '(') continue;
+        const char* h = t + 2;
+        long long v = 0;
+        int digits = 0;
+        while (h < close && is_digit(*h)) {
+          if (++digits > 18) return false;  // mirror refuses, not wrong
+          v = v * 10 + (*h - '0');
+          ++h;
+        }
+        if (digits >= 1 && h < close && *h == ')') {
+          space = v;
+          break;
+        }
+      }
+    }
+    q = close + 1;
+  } else {
+    out.push_back('n');
+  }
+  if (q != end) return false;  // the reference regex is anchored
+  out.push_back(':');
+  out += tiling;
+  out.push_back(':');
+  out += std::to_string(space);
+  return true;
+}
+
+// Encode a full (possibly tuple) shape span into the ';'-joined prefix
+// token stream.  False -> caller emits the raw-text fallback.
+bool enc_shape(const char* p, const char* end, std::string& out) {
+  end = trim_span(p, end);
+  if (p >= end) return false;
+  // parse_shape strips /*...*/ comments first; the mirror defers
+  for (const char* q = p; q + 1 < end; ++q)
+    if (q[0] == '/' && q[1] == '*') return false;
+  if (*p != '(') return enc_leaf(p, end, out);
+  const char* close = find_match(p, end);
+  if (!close) return false;
+  // split the tuple interior at top level (quote-aware depth count),
+  // mirroring split_top_level; trailing text past ')' is ignored like
+  // the reference's tuple branch
+  std::vector<std::pair<const char*, const char*>> parts;
+  {
+    const char* start = p + 1;
+    int depth = 0;
+    bool in_str = false;
+    for (const char* q = p + 1; q < close; ++q) {
+      char c = *q;
+      if (in_str) {
+        if (c == '\\') { ++q; continue; }
+        if (c == '"') in_str = false;
+      } else if (c == '"') {
+        in_str = true;
+      } else if (c == '(' || c == '{' || c == '[') {
+        ++depth;
+      } else if (c == ')' || c == '}' || c == ']') {
+        --depth;
+      } else if (c == ',' && depth == 0) {
+        parts.emplace_back(start, q);
+        start = q + 1;
+      }
+    }
+    const char* s = start;
+    const char* e = trim_span(s, close);
+    if (s < e || !parts.empty()) {
+      if (s < e) parts.emplace_back(start, close);
+      else if (!parts.empty()) return false;  // trailing empty part
+    }
+  }
+  out.push_back('(');
+  out += std::to_string(parts.size());
+  for (auto& pr : parts) {
+    out.push_back(';');
+    if (!enc_shape(pr.first, pr.second, out)) return false;
+  }
+  return true;
+}
+
+// split_top_level(raw_attr_text) in C++: GS-joined non-empty stripped
+// top-level tokens (the only consumers skip empties).
+void split_attr_tokens(const char* p, const char* end, std::string& out) {
+  const char* start = p;
+  int depth = 0;
+  bool in_str = false;
+  auto push = [&](const char* s, const char* e) {
+    e = trim_span(s, e);
+    if (s >= e) return;
+    if (!out.empty()) out.push_back('\x1d');
+    out.append(s, e - s);
+  };
+  for (const char* q = p; q < end; ++q) {
+    char c = *q;
+    if (in_str) {
+      if (c == '\\') { ++q; continue; }
+      if (c == '"') in_str = false;
+    } else if (c == '"') {
+      in_str = true;
+    } else if (c == '(' || c == '{' || c == '[') {
+      ++depth;
+    } else if (c == ')' || c == '}' || c == ']') {
+      --depth;
+    } else if (c == ',' && depth == 0) {
+      push(start, q);
+      start = q + 1;
+    }
+  }
+  push(start, end);
+}
+
 // Parse one instruction line: [ROOT] %name = shape opcode(operands), attrs
-// Returns false if the line is not an instruction.
-bool scan_instruction(const char* p, const char* end, Out& out) {
+// Returns false if the line is not an instruction.  v2 upgrades the
+// shape field to the pre-parsed token stream ('!'-prefixed raw text
+// when the mirror defers to the reference parser) and the attr field
+// to pre-split GS-joined top-level tokens.
+bool scan_instruction(const char* p, const char* end, Out& out,
+                      bool v2) {
   p = skip_ws(p, end);
   bool root = false;
   if (end - p > 5 && std::memcmp(p, "ROOT ", 5) == 0) {
@@ -163,10 +404,28 @@ bool scan_instruction(const char* p, const char* end, Out& out) {
   out.field("I", 1);
   out.field(name_start, name_end - name_start);
   out.field(root ? "1" : "0", 1);
-  out.field(shape_start, shape_end - shape_start);
+  if (v2) {
+    std::string enc;
+    if (enc_shape(shape_start, shape_end, enc)) {
+      out.field(enc);
+    } else {
+      enc.clear();
+      enc.push_back('!');
+      enc.append(shape_start, shape_end - shape_start);
+      out.field(enc);
+    }
+  } else {
+    out.field(shape_start, shape_end - shape_start);
+  }
   out.field(opcode_start, opcode_end - opcode_start);
   out.field(operands);
-  out.field(attrs, end - attrs);
+  if (v2) {
+    std::string toks;
+    split_attr_tokens(attrs, end, toks);
+    out.field(toks);
+  } else {
+    out.field(attrs, end - attrs);
+  }
   // constants need their literal; parameters their index (for fusion
   // operand-to-param mapping) — both ride in the final field
   const size_t op_len = opcode_end - opcode_start;
@@ -181,14 +440,11 @@ bool scan_instruction(const char* p, const char* end, Out& out) {
   return true;
 }
 
-}  // namespace
-
-extern "C" {
-
 // Scans the HLO text; returns a malloc'd record buffer (see header
 // comment) and stores its length in *out_len.  Caller must free with
 // hlo_scan_free.  Returns nullptr on allocation failure.
-char* hlo_scan(const char* text, uint64_t len, uint64_t* out_len) {
+char* scan_impl(const char* text, uint64_t len, uint64_t* out_len,
+                bool v2) {
   Out out;
   out.buf.reserve(len / 2);
   const char* p = text;
@@ -250,7 +506,7 @@ char* hlo_scan(const char* text, uint64_t len, uint64_t* out_len) {
         out.end_record();
         in_comp = false;
       } else {
-        scan_instruction(s, line_end, out);
+        scan_instruction(s, line_end, out, v2);
       }
     }
     if (!nl) break;
@@ -270,8 +526,23 @@ char* hlo_scan(const char* text, uint64_t len, uint64_t* out_len) {
   return result;
 }
 
+}  // namespace
+
+extern "C" {
+
+char* hlo_scan(const char* text, uint64_t len, uint64_t* out_len) {
+  return scan_impl(text, len, out_len, false);
+}
+
+// parse-to-columns variant: pre-parsed shapes + pre-split attr tokens
+char* hlo_scan2(const char* text, uint64_t len, uint64_t* out_len) {
+  return scan_impl(text, len, out_len, true);
+}
+
 void hlo_scan_free(char* p) { std::free(p); }
 
 int hlo_scan_abi_version() { return 1; }
+
+int hlo_scan2_abi_version() { return 1; }
 
 }  // extern "C"

@@ -1,0 +1,428 @@
+"""tpusim.fastpath.store — the durable compiled-module tier.
+
+The tier's contract has three legs, each pinned here:
+
+* **byte identity** — a module priced from disk-loaded columns must
+  reproduce the serial walk and the freshly-compiled fastpath float for
+  float, per-op aggregates included;
+* **cross-process durability semantics** — torn/corrupt records
+  quarantine with exactly one warning and heal on recompile, stale
+  model/parser versions orphan records into plain misses, and N
+  processes racing one cold key converge on identical results with no
+  torn reads;
+* **zero-IR cold path** — with a warm store, a defer-parsed trace
+  prices without building a single IR op (no computation parses, no
+  span index).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import subprocess
+import sys
+import warnings
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+SILICON = REPO / "reports" / "silicon"
+CI_TRACES = REPO / "tests" / "fixtures" / "traces"
+
+pytestmark = pytest.mark.skipif(
+    not pytest.importorskip("numpy"), reason="numpy unavailable"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tiers():
+    """Every test starts and ends with no process-wide compiled state."""
+    from tpusim.fastpath.store import set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache
+
+    set_compile_store(None)
+    clear_compiled_cache()
+    yield
+    set_compile_store(None)
+    clear_compiled_cache()
+
+
+def _load_module(trace_dir: Path, defer: bool | None = None):
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(trace_dir, defer_parse=defer)
+    return pod.modules[sorted(pod.modules)[0]]
+
+
+def _engine(arch="v5e", backend=None):
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+
+    return Engine(load_config(arch=arch), pricing_backend=backend)
+
+
+def _doc(result) -> str:
+    from tpusim.perf.cache import result_to_doc
+
+    return json.dumps(result_to_doc(result), sort_keys=False)
+
+
+def _trace_dirs() -> list[Path]:
+    manifest = json.loads((SILICON / "manifest.json").read_text())
+    return [SILICON / e["trace"] for e in manifest["workloads"]]
+
+
+# ---------------------------------------------------------------------------
+# Round trip + byte identity
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_byte_identity_full_corpus(tmp_path):
+    """serial == fresh-compile == disk-loaded, for every fixture module
+    (multi-chip CI traces included: collectives, tuples, while loops)."""
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache
+
+    dirs = _trace_dirs() + [
+        d for d in sorted(CI_TRACES.iterdir()) if d.is_dir()
+    ]
+    serial = {}
+    for d in dirs:
+        serial[d.name] = _doc(
+            _engine(backend="serial").run(_load_module(d))
+        )
+
+    store = CompileStore(tmp_path)
+    set_compile_store(store)
+    fresh = {}
+    for d in dirs:
+        fresh[d.name] = _doc(_engine().run(_load_module(d)))
+    assert store.stores == len(dirs)
+
+    clear_compiled_cache()
+    store2 = CompileStore(tmp_path)
+    set_compile_store(store2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        loaded = {}
+        for d in dirs:
+            loaded[d.name] = _doc(_engine().run(_load_module(d)))
+    assert store2.hits == len(dirs)
+    assert store2.misses == 0
+    for name in serial:
+        assert fresh[name] == serial[name], name
+        assert loaded[name] == serial[name], name
+
+
+def test_record_carries_module_scalars(tmp_path):
+    """entry_name (and the residency slots) ride the record, so a
+    loaded instance answers without touching the module."""
+    from tpusim.fastpath.store import (
+        CompileStore, read_record_header, set_compile_store,
+    )
+    from tpusim.perf.cache import clear_compiled_cache
+
+    d = _trace_dirs()[0]
+    store = CompileStore(tmp_path)
+    set_compile_store(store)
+    mod = _load_module(d)
+    _engine().run(mod)
+    records = list(Path(tmp_path).glob("*.cmod"))
+    assert len(records) == 1
+    header = read_record_header(records[0])
+    assert header["module"]["entry_name"] == mod.entry_name
+
+    clear_compiled_cache()
+    set_compile_store(CompileStore(tmp_path))
+    mod2 = _load_module(d)
+    eng = _engine()
+    from tpusim.perf.cache import compiled_for
+
+    cm = compiled_for(mod2, eng)
+    assert cm.entry_name == mod.entry_name
+    assert cm.comps  # populated from disk, no compile needed
+
+
+# ---------------------------------------------------------------------------
+# Corruption / staleness
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_record_quarantines_once_and_heals(tmp_path):
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.perf.cache import clear_compiled_cache
+
+    d = _trace_dirs()[0]
+    set_compile_store(CompileStore(tmp_path))
+    want = _doc(_engine().run(_load_module(d)))
+
+    record = next(Path(tmp_path).glob("*.cmod"))
+    raw = record.read_bytes()
+    record.write_bytes(raw[: len(raw) // 2])  # torn write
+
+    clear_compiled_cache()
+    store = CompileStore(tmp_path)
+    set_compile_store(store)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = _doc(_engine().run(_load_module(d)))
+    assert got == want
+    relevant = [
+        w for w in caught if "compiled-module" in str(w.message)
+    ]
+    assert len(relevant) == 1  # exactly one warning, ever
+    assert store.quarantined == 1
+    assert (Path(tmp_path) / "quarantine").is_dir()
+    # the recompile's publish healed the store: a fresh lookup is a
+    # clean hit with zero warnings
+    clear_compiled_cache()
+    store3 = CompileStore(tmp_path)
+    set_compile_store(store3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert _doc(_engine().run(_load_module(d))) == want
+    assert store3.hits >= 1
+
+
+def test_stale_model_version_is_a_plain_miss(tmp_path):
+    """A model/parser bump orphans old records: no warning, no load —
+    and verify_store counts them as stale."""
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.guard.store import verify_store
+    from tpusim.perf.cache import clear_compiled_cache
+
+    d = _trace_dirs()[0]
+    store = CompileStore(tmp_path)
+    store._model_version = "ancient+parser"  # records stamp this
+    set_compile_store(store)
+    _engine().run(_load_module(d))
+    assert store.stores == 1
+
+    res = verify_store(tmp_path)
+    assert res.compiled_checked == 1
+    assert res.stale_model == 1  # well-formed, merely unreachable
+    assert res.quarantined_corrupt == 0
+
+    clear_compiled_cache()
+    live = CompileStore(tmp_path)  # live composite stamp
+    set_compile_store(live)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _engine().run(_load_module(d))
+    assert live.hits == 0
+    assert live.quarantined == 0  # stale, not corrupt
+    assert live.stores == 1  # the recompile re-published under the
+    # same key, healing the record to the live stamp
+    res = verify_store(tmp_path)
+    assert res.stale_model == 0
+    assert res.ok == 1
+
+
+# ---------------------------------------------------------------------------
+# Cross-process race
+# ---------------------------------------------------------------------------
+
+
+def _race_child(trace_dir: str, store_dir: str, q) -> None:
+    try:
+        import warnings as _w
+
+        from tpusim.fastpath.store import CompileStore, set_compile_store
+        from tpusim.trace.format import load_trace
+
+        set_compile_store(CompileStore(store_dir))
+        pod = load_trace(trace_dir)
+        mod = pod.modules[sorted(pod.modules)[0]]
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            doc = _doc(_engine().run(mod))
+        q.put(("ok", doc))
+    except BaseException as e:  # noqa: BLE001 - report, don't hang
+        q.put(("err", f"{type(e).__name__}: {e}"))
+
+
+def test_processes_racing_one_cold_key_converge(tmp_path):
+    """N processes pricing the same cold module into one store dir all
+    succeed with byte-identical results, no torn reads, and exactly one
+    servable record at the end."""
+    d = _trace_dirs()[0]
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_race_child, args=(str(d), str(tmp_path), q))
+        for _ in range(3)
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=60)
+    statuses = {s for s, _ in results}
+    assert statuses == {"ok"}, results
+    docs = {doc for _, doc in results}
+    assert len(docs) == 1
+    records = list(Path(tmp_path).glob("*.cmod"))
+    assert len(records) == 1
+    assert not (Path(tmp_path) / "quarantine").exists()
+    # and the record the racers converged on loads cleanly
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+
+    store = CompileStore(tmp_path)
+    set_compile_store(store)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert _doc(_engine().run(_load_module(d))) in docs
+    assert store.hits == 1
+
+
+# ---------------------------------------------------------------------------
+# Zero-IR cold path
+# ---------------------------------------------------------------------------
+
+
+def test_warm_store_prices_with_zero_ir_construction(tmp_path):
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.ir import ir_build_counter
+    from tpusim.perf.cache import clear_compiled_cache
+
+    d = _trace_dirs()[0]
+    set_compile_store(CompileStore(tmp_path))
+    want = _doc(_engine().run(_load_module(d)))
+
+    clear_compiled_cache()
+    set_compile_store(CompileStore(tmp_path))
+    mod = _load_module(d)  # defer_parse auto-engages (store active)
+    before = ir_build_counter["ops"]
+    got = _doc(_engine().run(mod))
+    assert got == want
+    assert ir_build_counter["ops"] == before  # zero ops built
+    assert mod.parsed_count == 0  # no computation ever parsed
+    assert mod._spans_cache is None  # not even the span index
+
+
+def test_lazy_span_index_builds_on_demand():
+    """The deferred span index is transparent: entry access, pricing,
+    and residency scans on a lazy module still work (and match the
+    eager parse) when no store serves them."""
+    from tpusim.trace.format import load_trace
+
+    d = _trace_dirs()[0]
+    eager = load_trace(d, defer_parse=False)
+    lazy = load_trace(d, defer_parse=True)
+    name = sorted(eager.modules)[0]
+    em, lm = eager.modules[name], lazy.modules[name]
+    assert lm._spans_cache is None
+    assert lm.entry_name == em.entry_name  # forces the index
+    assert lm._spans_cache is not None
+    assert sorted(lm.computations.keys()) == sorted(
+        em.computations.keys()
+    )
+    assert _doc(_engine(backend="serial").run(lm)) == _doc(
+        _engine(backend="serial").run(em)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Guard integration: tiers in one store
+# ---------------------------------------------------------------------------
+
+
+def test_gc_and_scan_cover_both_tiers(tmp_path):
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+    from tpusim.guard.store import gc_store, scan_store
+    from tpusim.perf.cache import ResultCache
+
+    d = _trace_dirs()[0]
+    set_compile_store(CompileStore(tmp_path))
+    mod = _load_module(d)
+    cache = ResultCache(disk_dir=tmp_path)
+    from tpusim.perf.cache import CachedEngine
+    from tpusim.timing.config import load_config
+
+    CachedEngine(load_config(arch="v5e"), result_cache=cache).run(mod)
+    stats = scan_store(tmp_path)
+    assert stats.result_entries == 1
+    assert stats.compiled_entries == 1
+    assert stats.entries == 2
+    assert stats.bytes == stats.result_bytes + stats.compiled_bytes
+    res = gc_store(tmp_path, max_entries=0)
+    assert res.deleted == 2  # tier-blind whole-record eviction
+    assert scan_store(tmp_path).entries == 0
+
+
+def test_cache_cli_covers_compiled_tier(tmp_path):
+    """``tpusim cache stats|verify|clear`` see (and govern) ``.cmod``
+    records beside the result records."""
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+
+    d = _trace_dirs()[0]
+    set_compile_store(CompileStore(tmp_path))
+    _engine().run(_load_module(d))
+    set_compile_store(None)
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tpusim", "cache", *args,
+             "--dir", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    out = cli("stats")
+    assert out.returncode == 0
+    assert "compiled: 1" in out.stdout
+
+    record = next(Path(tmp_path).glob("*.cmod"))
+    record.write_bytes(b"TPUCMODX garbage")
+    out = cli("verify")
+    assert out.returncode == 0
+    assert "quarantined (corrupt): 1" in out.stdout
+    assert not record.exists()
+
+    out = cli("clear")
+    assert out.returncode == 0
+    assert not list(Path(tmp_path).glob("*.cmod"))
+    assert not (tmp_path / "quarantine").exists()
+
+
+def test_compile_cache_cli_flag_end_to_end(tmp_path):
+    """``tpusim simulate --compile-cache``: run 2 loads what run 1
+    compiled (fastpath_store_hits on the report), byte-identical
+    stats."""
+    store_dir = tmp_path / "store"
+    trace = _trace_dirs()[0]
+
+    def run(json_out):
+        return subprocess.run(
+            [sys.executable, "-m", "tpusim", "simulate", str(trace),
+             "--arch", "v5e", "--compile-cache", str(store_dir),
+             "--json", str(json_out)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    r1 = run(tmp_path / "a.json")
+    assert r1.returncode == 0, r1.stderr
+    r2 = run(tmp_path / "b.json")
+    assert r2.returncode == 0, r2.stderr
+    a = json.loads((tmp_path / "a.json").read_text())
+    b = json.loads((tmp_path / "b.json").read_text())
+    assert a["fastpath_store_writes"] >= 1
+    assert b["fastpath_store_hits"] >= 1
+    assert b["fastpath_ir_ops_built"] == 0  # defer-parse + warm store
+    strip = ("simulation_rate_kops", "silicon_slowdown", "sim_elapsed_s")
+    sa = {k: v for k, v in a.items()
+          if not k.startswith("fastpath_") and k not in strip}
+    sb = {k: v for k, v in b.items()
+          if not k.startswith("fastpath_") and k not in strip}
+    assert sa == sb
+
+
+# ---------------------------------------------------------------------------
+# Namespace registration
+# ---------------------------------------------------------------------------
+
+
+def test_fastpath_namespace_licenses_serve():
+    from tpusim.analysis.statskeys import STATS_NAMESPACES
+
+    assert "tpusim/serve/" in STATS_NAMESPACES["fastpath_"]

@@ -128,6 +128,98 @@ def test_native_speedup_on_large_module():
     # native is usually ~5-10x faster; allow slack for noisy CI machines
     assert t_nat < t_py * 1.2, (t_nat, t_py)
 
+# ---------------------------------------------------------------------------
+# v2: parse-to-columns
+# ---------------------------------------------------------------------------
+
+
+def _assert_leaves_exact(a, b):
+    """Full TensorSpec field equality (layout/tiling/memory_space
+    included — the columns the v2 scan pre-parses in C++)."""
+    from tpusim.ir import leaves_of
+
+    for cname, comp_a in a.computations.items():
+        for oa, ob in zip(comp_a.ops, b.computations[cname].ops):
+            la, lb = leaves_of(oa.result), leaves_of(ob.result)
+            assert len(la) == len(lb), oa.name
+            for x, y in zip(la, lb):
+                assert (
+                    x.dtype, x.shape, x.layout, x.tiling, x.memory_space
+                ) == (
+                    y.dtype, y.shape, y.layout, y.tiling, y.memory_space
+                ), oa.name
+            assert oa.attrs == ob.attrs, oa.name
+            assert oa.metadata == ob.metadata, oa.name
+
+
+def test_v2_scan_available():
+    import tpusim.trace.native as tn
+
+    tn._load()
+    assert tn._HAS_V2  # the rebuilt library exports hlo_scan2
+
+
+def test_v2_parity_full_fields_on_corpus():
+    """v2 (pre-parsed shapes + pre-split attrs) must equal the Python
+    reference on every committed trace module, field for field."""
+    import json
+
+    corpus = [(FIXTURES / "tiny_mlp.hlo").read_text()]
+    silicon = REPO / "reports" / "silicon"
+    manifest = json.loads((silicon / "manifest.json").read_text())
+    for e in manifest["workloads"]:
+        for p in (silicon / e["trace"] / "modules").glob("*.hlo"):
+            corpus.append(p.read_text())
+    for tdir in sorted((FIXTURES / "traces").iterdir()):
+        for p in (tdir / "modules").glob("*.hlo"):
+            corpus.append(p.read_text())
+    for text in corpus:
+        m_py = parse_hlo_module(text, "x")
+        m_v2 = parse_hlo_module_native(text, "x")
+        _assert_same_module(m_py, m_v2)
+        _assert_leaves_exact(m_py, m_v2)
+
+
+def test_v2_shape_edge_cases_match_reference():
+    """Fast-path and fallback ('!'-prefixed raw) shapes both land on
+    the reference parser's exact TensorSpec — including the shapes the
+    C++ mirror deliberately refuses (comments, odd layouts)."""
+    tmpl = (
+        "HloModule m\n\nENTRY %e (p: f32[2]) -> f32[2] {\n"
+        "  %p = SHAPE parameter(0)\n"
+        "  ROOT %r = f32[2]{0} add(%p, %p)\n}\n"
+    )
+    shapes = [
+        "f32[2]", "f32[]", "pred[]", "u32[08]", "s32[<=128]",
+        "bf16[256,512]{1,0:T(8,128)(2,1)}",
+        "f32[8,128]{1,0:T(8,128)S(1)}",
+        "f32[2,3]{1,0:T(2,1)(8,128)S(3)}",
+        "(f32[2]{0}, u32[])",
+        "((f32[2], s8[3,4]{1,0}), token[])",
+        "(f32[2]{0:T(2)S(1)}, (u32[], pred[1]))",
+        "f32[2]/*cmt*/", "c128[4]{0:T(4)}", "f8e4m3[16]{0}",
+    ]
+    for s in shapes:
+        text = tmpl.replace("SHAPE", s)
+        m_py = parse_hlo_module(text, "x")
+        m_v2 = parse_hlo_module_native(text, "x")
+        _assert_same_module(m_py, m_v2)
+        _assert_leaves_exact(m_py, m_v2)
+
+
+def test_v1_fallback_when_v2_absent(monkeypatch):
+    """An older library without hlo_scan2 still parses through the v1
+    record stream, byte-identically."""
+    import tpusim.trace.native as tn
+
+    text = (FIXTURES / "tiny_mlp.hlo").read_text()
+    want = parse_hlo_module_native(text, "x")
+    monkeypatch.setattr(tn, "_HAS_V2", False)
+    got = parse_hlo_module_native(text, "x")
+    _assert_same_module(want, got)
+    _assert_leaves_exact(want, got)
+
+
 def test_native_robust_to_line_ending_variants():
     """CRLF, trailing whitespace, and %-less headers must parse the same
     as the Python reference (a trace dir copied through Windows must not

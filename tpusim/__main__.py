@@ -53,6 +53,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     # --max-rss mounts the memory watchdog whose terminal shed step
     # cancels the run cleanly instead of meeting the OOM-killer
     result_cache = args.result_cache
+    compile_cache = getattr(args, "compile_cache", None)
     cancel = None
     watchdog = None
     try:
@@ -64,6 +65,15 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
                 True if result_cache is None else result_cache
             )
             result_cache.quota_bytes = parse_size(args.cache_quota)
+        if compile_cache and getattr(args, "cache_quota", None):
+            # one quota governs the whole store dir — the compiled tier
+            # enforces it on its own publishes like every other writer
+            from tpusim.fastpath.store import as_compile_store
+            from tpusim.guard.store import parse_size
+
+            compile_cache = as_compile_store(
+                compile_cache, quota_bytes=parse_size(args.cache_quota)
+            )
         if getattr(args, "max_wall_s", None):
             from tpusim.guard.cancel import CancelToken
 
@@ -103,6 +113,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
             validate=args.validate,
             result_cache=result_cache, workers=args.workers,
             pricing_backend=args.pricing_backend, cancel=cancel,
+            compile_cache=compile_cache,
         )
     except OperationCancelled as e:
         # the clean refusal: nothing half-written, caches warm on disk
@@ -402,6 +413,11 @@ def _cmd_faults(args: argparse.Namespace) -> int:
     from tpusim.ici.topology import torus_for
     from tpusim.timing.config import load_config
 
+    if getattr(args, "compile_cache", None):
+        # activate before the trace loads so its parse defers
+        from tpusim.fastpath.store import as_compile_store
+
+        as_compile_store(args.compile_cache)
     cfg = load_config(arch=args.arch)
     arch_name = cfg.arch.name
     topo = torus_for(args.chips, arch_name)
@@ -519,6 +535,7 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
             workers=args.workers,
             progress=progress,
             cancel=cancel,
+            compile_cache=args.compile_cache,
         )
     except OperationCancelled as e:
         hint = (
@@ -597,6 +614,7 @@ def _cmd_advise(args: argparse.Namespace) -> int:
             result_cache=args.result_cache,
             workers=args.workers,
             progress=progress,
+            compile_cache=args.compile_cache,
         )
     except AdviseSpecError as e:
         print(f"tpusim advise: spec refused ({e.code}): {e}",
@@ -689,6 +707,7 @@ def _cmd_serve_front(args: argparse.Namespace) -> int:
         "disk_quota": args.cache_quota,
         "max_rss": args.max_rss,
         "max_worker_rss": args.max_worker_rss,
+        "compile_cache": args.compile_cache,
         "hot_cache": args.hot_cache,
         "quarantine_dir": quarantine_dir,
     }
@@ -749,6 +768,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             cache_quota=args.cache_quota,
             max_rss=args.max_rss,
             max_worker_rss=args.max_worker_rss,
+            compile_cache=args.compile_cache,
             hot_cache=args.hot_cache,
         )
     except ValueError as e:
@@ -1261,6 +1281,15 @@ def main(argv: list[str] | None = None) -> int:
                          "implies --result-cache and garbage-collects "
                          "least-recently-used records past the quota "
                          "(tpusim.guard)")
+    ps.add_argument("--compile-cache", nargs="?", const=True, default=None,
+                    metavar="DIR",
+                    help="durable compiled-module tier (tpusim.fastpath."
+                         "store; default dir .tpusim_cache/, beside the "
+                         "result records): compiled pricing columns "
+                         "persist across processes, so a warm store "
+                         "prices a cold run from mmapped columns with "
+                         "zero Python IR construction; stamps "
+                         "fastpath_* stats")
     ps.add_argument("--max-wall-s", type=float, default=None, metavar="S",
                     help="cooperative wall-clock budget: the replay "
                          "cancels cleanly at the next command/op "
@@ -1428,6 +1457,11 @@ def main(argv: list[str] | None = None) -> int:
                           "sweep's replays (--trace sweeps; in-memory "
                           "sharing is always on, this adds the disk "
                           "tier)")
+    pfa.add_argument("--compile-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="durable compiled-module tier: every sweep "
+                          "scenario shares one compile, persisted "
+                          "across runs (tpusim.fastpath.store)")
     pfa.set_defaults(fn=_cmd_faults)
 
     pca = sub.add_parser(
@@ -1479,6 +1513,12 @@ def main(argv: list[str] | None = None) -> int:
                      help="share the engine-result cache on disk "
                           "(in-memory sharing across scenarios is "
                           "always on; this persists it across runs)")
+    pcm.add_argument("--compile-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="durable compiled-module tier: a fresh "
+                          "campaign over an already-compiled trace "
+                          "parses and compiles nothing "
+                          "(tpusim.fastpath.store)")
     pcm.add_argument("--max-wall-s", type=float, default=None, metavar="S",
                      help="cooperative wall-clock budget: the campaign "
                           "cancels at the next scenario boundary with "
@@ -1513,6 +1553,11 @@ def main(argv: list[str] | None = None) -> int:
                           "(in-memory sharing across cells is always "
                           "on; this persists it — a warm re-run prices "
                           "zero engine walks)")
+    pad.add_argument("--compile-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="durable compiled-module tier: cell clones "
+                          "compile once ever per (content, config) "
+                          "(tpusim.fastpath.store)")
     pad.add_argument("--json", default=None,
                      help="also write the ranked report document here")
     pad.add_argument("--verbose", action="store_true",
@@ -1584,6 +1629,13 @@ def main(argv: list[str] | None = None) -> int:
                           "2G); the daemon AND every serve-worker "
                           "garbage-collect least-recently-used records "
                           "past it (tpusim.guard)")
+    psv.add_argument("--compile-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="durable compiled-module tier shared by the "
+                          "daemon and every serve-worker: a cold first "
+                          "request against a warm store prices from "
+                          "mmapped columns with zero Python IR "
+                          "construction (tpusim.fastpath.store)")
     psv.add_argument("--max-rss", default=None, metavar="SIZE",
                      help="daemon memory watchdog hard threshold: past "
                           "it the degradation ladder shrinks caches, "

@@ -173,6 +173,7 @@ def run_advise(
     validate: bool = True,
     progress=None,
     cancel=None,
+    compile_cache=None,
 ) -> AdviseResult:
     """Execute one advise sweep end to end.
 
@@ -194,6 +195,13 @@ def run_advise(
     from tpusim.timing.model_version import model_version
 
     t0 = time.perf_counter()
+    if compile_cache is not None and compile_cache is not False:
+        # mount the durable compiled tier (tpusim.fastpath.store)
+        # before the trace loads; scaled cell clones each compile once
+        # ever per (content, config) and persist for later sweeps
+        from tpusim.fastpath.store import as_compile_store
+
+        as_compile_store(compile_cache)
     spec = load_advise_spec(spec_src)
     if pod is None:
         if trace_path is None:

@@ -87,15 +87,17 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/campaign/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
-    # the pricing fastpath (PR 8): compiled-pricing accounting (resolved
-    # backend, compiled-module cache hits/misses) — stamped by the
-    # driver ONLY when a --pricing-backend was explicitly requested
-    # (the cache_*/pool_* discipline: default auto-fastpath runs stay
-    # key-identical, which is what keeps the golden matrix byte-stable
-    # with the fastpath on)
+    # the pricing fastpath (PR 8, durable tier PR 12): compiled-pricing
+    # accounting (resolved backend, compiled-module cache hits/misses,
+    # durable-store hits/writes) — stamped by the driver ONLY when a
+    # --pricing-backend was explicitly requested or a --compile-cache
+    # store is active (the cache_*/pool_* discipline: default
+    # auto-fastpath runs stay key-identical, which is what keeps the
+    # golden matrix byte-stable with the fastpath on); tpusim.serve
+    # mirrors the block on /metrics when the store is mounted
     "fastpath_": (
         "tpusim/fastpath/", "tpusim/sim/driver.py", "tpusim/__main__.py",
-        "bench.py", "ci/check_golden.py",
+        "tpusim/serve/", "bench.py", "ci/check_golden.py",
     ),
     # resource governance (tpusim.guard): store-quota/GC accounting,
     # memory-watchdog gauges, cooperative-cancellation counters —

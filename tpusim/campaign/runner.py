@@ -267,6 +267,7 @@ def run_campaign(
     progress=None,
     sleep=time.sleep,
     cancel=None,
+    compile_cache=None,
 ) -> CampaignResult:
     """Execute one campaign end to end.
 
@@ -291,6 +292,14 @@ def run_campaign(
     from tpusim.timing.model_version import model_version
 
     t0 = time.perf_counter()
+    if compile_cache is not None and compile_cache is not False:
+        # mount the durable compiled tier (tpusim.fastpath.store)
+        # before the trace loads: every scenario of every slice shares
+        # one compile, and a fresh campaign over an already-compiled
+        # trace parses and compiles nothing
+        from tpusim.fastpath.store import as_compile_store
+
+        as_compile_store(compile_cache)
     if resume and out_dir is None:
         # silently re-pricing a whole campaign the caller believes is
         # resuming would be the worst possible interpretation

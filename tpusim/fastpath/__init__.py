@@ -18,10 +18,18 @@ other item").  This package splits that walk into two phases:
   channels, ICI rendezvous, HBM contention, control flow) steps through
   the same scalar logic as the reference walk.
 
+* **store** (:mod:`tpusim.fastpath.store`) — the durable tier: compiled
+  columns + step programs serialized into the shared disk store beside
+  the PR 4 result records (``.cmod`` beside ``.json``), mmap-loaded by
+  ``compiled_for`` before any compile — a fleet compiles each module
+  once *ever*, and with a warm store a fresh process prices without
+  constructing a single IR object.
+
 Contract: every backend — ``serial`` (the reference per-op walk in
 :class:`tpusim.timing.engine.Engine`), ``vectorized``, and ``native`` —
-produces **byte-identical** :class:`EngineResult` counters, pinned by
-the parity corpus in ``tests/test_fastpath.py`` and the
+produces **byte-identical** :class:`EngineResult` counters, disk-loaded
+columns included, pinned by the parity corpus in
+``tests/test_fastpath.py`` / ``tests/test_compile_store.py`` and the
 ``--fastpath-parity`` CI smoke.  The fastpath disengages (falls back to
 the serial walk) under obs instrumentation, timeline recording, and
 op-granularity checkpoint/resume — see ``resolve_backend``.
@@ -36,15 +44,27 @@ from tpusim.fastpath.price import (
     resolve_backend,
 )
 from tpusim.fastpath.native import native_price_available
+from tpusim.fastpath.store import (
+    CompileStore,
+    as_compile_store,
+    compile_store_active,
+    get_compile_store,
+    set_compile_store,
+)
 
 __all__ = [
     "BACKENDS",
+    "CompileStore",
     "CompiledComputation",
     "CompiledModule",
+    "as_compile_store",
     "compile_module",
+    "compile_store_active",
     "fastpath_eligible",
+    "get_compile_store",
     "native_price_available",
     "numpy_available",
     "price_module",
     "resolve_backend",
+    "set_compile_store",
 ]

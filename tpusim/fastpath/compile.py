@@ -113,6 +113,24 @@ class CompiledModule:
         self.lean = lean               # skip per-op identity (streaming)
         self.release_ir = release_ir   # drop parsed IR after compile
         self.comps: dict[str, CompiledComputation] = {}
+        # content-derived module scalars cached beside the columns so a
+        # disk-loaded instance never re-scans the trace text: the entry
+        # computation's name, the raw S(1) residency sum (tagged with
+        # the scan KIND that produced it — the raw-text and IR-walk
+        # residency estimators are deliberately kept from
+        # cross-serving, same as the engine's per-kind scalar memo),
+        # and (when a spill run computed it) the peak-live refinement
+        # (one estimator only, kind-free)
+        self.entry_name: str | None = None
+        self.residency: float | None = None
+        self.residency_kind: str | None = None
+        self.peak_live: float | None = None
+        # durable tier bookkeeping (tpusim.fastpath.store): the string
+        # key the instance publishes under (None = bypass population —
+        # custom cost models, unfingerprintable modules) and whether a
+        # pricing walk compiled columns not yet on disk
+        self._store_key: str | None = None
+        self._store_dirty = False
 
     def bind(self, module: ModuleTrace, cost: CostModel) -> None:
         """(Re)attach the live module for lazy compiles of computations
@@ -142,6 +160,7 @@ class CompiledModule:
                 module, comp, self.cost, self.config, lean=self.lean
             )
             self.comps[name] = cc
+            self._store_dirty = True
             if self.release_ir:
                 release = getattr(module, "release_computation", None)
                 if release is not None:

@@ -284,25 +284,55 @@ def price_module(engine, module, backend: str) -> EngineResult:
     topo = engine._topology_for(module)
     coll = make_collective_model(topo, engine.arch.ici, obs=engine.obs)
     result = EngineResult()
+    cm = compiled_for(module, engine)
     spill_frac = 1.0
     if engine.config.model_vmem_capacity:
-        resident = _residency_of(module)
+        # module-content scalars ride the compiled form (a disk-loaded
+        # instance must not re-scan trace text it never parsed); the
+        # stored floats round-trip exactly, so spill pricing is
+        # byte-identical either way.  The cached residency is reused
+        # only when its scan KIND matches this module's representation
+        # (text scan for lazy/streaming, IR walk for eager) — the two
+        # estimators never cross-serve, exactly like the engine's
+        # per-kind scalar memo, so a run's value cannot depend on
+        # which representation populated the store first.
+        kind = "text" if callable(
+            getattr(module, "vmem_resident_bytes", None)
+        ) else "ir"
+        resident = cm.residency if cm.residency_kind == kind else None
+        if resident is None:
+            resident = _residency_of(module)
+            cm.residency, cm.residency_kind = resident, kind
         cap = float(engine.arch.vmem_bytes)
         if resident > cap > 0:
-            resident = engine._peak_live_of(module)
+            peak = cm.peak_live
+            if peak is None:
+                peak = cm.peak_live = engine._peak_live_of(module)
+            resident = peak
         result.vmem_resident_bytes = resident
         if resident > cap > 0:
             spill_frac = cap / resident
-    cm = compiled_for(module, engine)
     ctx = _Ctx(
         engine, cm, coll, spill_frac, backend,
         per_op=not cm.lean,
     )
-    entry = module.entry  # same no-ENTRY ValueError as the serial walk
-    end = _price_computation(ctx, entry.name, 0.0, result, 0)
+    # entry resolution avoids forcing a lazy/streaming module to parse
+    # (or even span-index) when the compiled columns already hold the
+    # answer; the nameless case raises the serial walk's exact no-ENTRY
+    # ValueError
+    entry_name = cm.entry_name
+    if entry_name is None:
+        entry_name = module.entry_name
+        if entry_name is None:
+            module.entry  # raises ValueError (no ENTRY computation)
+        cm.entry_name = entry_name
+    end = _price_computation(ctx, entry_name, 0.0, result, 0)
     result.cycles = end
     result.seconds = engine.arch.cycles_to_seconds(end)
     result.samples = None
+    from tpusim.fastpath.store import maybe_persist_compiled
+
+    maybe_persist_compiled(cm)
     return result
 
 

@@ -1,0 +1,697 @@
+"""Durable compiled-module store — the fastpath's disk tier.
+
+PR 8's compile pass (:mod:`tpusim.fastpath.compile`) turns a module into
+float64 columns + a step program once per *process*; this module makes
+that form durable so a fleet compiles each module once *ever*.  Records
+live beside the PR 4 result records in the same store directory
+(``.cmod`` beside ``.json`` — one quota, one GC, one operator CLI) under
+the same key family the in-memory compiled tier already uses:
+
+    (module content fingerprint, capture platform,
+     composed-config fingerprint, model+parser version, lean flag)
+
+A key is a statement about the code that produced the columns: any edit
+to the timing model or the parsers bumps the composite version and the
+old records simply stop matching (aged out by GC, counted by
+``tpusim cache verify``).
+
+Record format (binary, one file per key)::
+
+    TPUCMOD1 | u64 header_len | header JSON | pad to 8 | column blob
+
+The header carries the step programs and identity tables as JSON; every
+numeric array (the pricing columns and the run-step index tables) lives
+in the blob as raw little-endian 8-byte lanes and is *mmapped* on load —
+a forked serve worker or campaign process maps columns instead of
+rebuilding IR, and N processes loading one record share the page cache.
+
+Write discipline mirrors the result cache: staged to a
+``(pid, thread)``-keyed temp file, published with ``os.replace`` (+
+fsync when durable), so readers only ever see whole records.  A corrupt
+or truncated record quarantines on first detection
+(:func:`tpusim.guard.store.quarantine_record`) with one warning and a
+recompile that heals the store; a record from another model/parser
+version is a plain miss.
+
+Activation is process-wide (``set_compile_store`` /
+``$TPUSIM_COMPILE_CACHE`` / the ``--compile-cache`` flag family): the
+compiled tier is consulted by :func:`tpusim.perf.cache.compiled_for`
+before any compile, and :func:`maybe_persist_compiled` publishes after
+a pricing walk populates fresh columns.  Off by default — un-configured
+runs do zero added work and stamp zero added stats keys.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from pathlib import Path
+
+__all__ = [
+    "COMPILE_RECORD_SUFFIX",
+    "COMPILE_STORE_FORMAT_VERSION",
+    "CompileStore",
+    "as_compile_store",
+    "compile_store_active",
+    "get_compile_store",
+    "maybe_persist_compiled",
+    "read_record_header",
+    "set_compile_store",
+]
+
+COMPILE_STORE_FORMAT_VERSION = 1
+COMPILE_RECORD_SUFFIX = ".cmod"
+
+_MAGIC = b"TPUCMOD1"
+_HDR_FIXED = len(_MAGIC) + 8  # magic + u64 header length
+
+
+def _np():
+    import numpy
+
+    return numpy
+
+
+#: the f64 pricing columns of one CompiledComputation, in a fixed order
+#: (the record format's column table)
+_COLUMN_ATTRS = (
+    "cycles", "compute", "hbm", "vmem", "hrs", "vrs",
+    "flops", "mxu", "trans", "ici_bytes",
+)
+
+
+# ---------------------------------------------------------------------------
+# (De)serialization of the step program
+# ---------------------------------------------------------------------------
+
+
+class _BlobWriter:
+    """Accumulates the record's two binary sections: 8-byte-lane arrays
+    (the mmapped columns + index tables) and a raw strings tail (per-op
+    identity — stored as joined text/index bytes, NOT as JSON arrays: a
+    12k-op module's name table is 12k strings, and json.loads on that
+    costs more than the entire pricing walk it enables)."""
+
+    def __init__(self):
+        self.parts: list[bytes] = []
+        self.table: list[list] = []  # [dtype_str, offset, count]
+        self.offset = 0
+        self.tail_parts: list[bytes] = []
+        self.tail_offset = 0
+
+    def add(self, arr) -> int:
+        np = _np()
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.itemsize != 8:
+            # index tables are intp; columns f64 — both 8-byte lanes,
+            # which is what keeps every blob offset 8-aligned
+            arr = arr.astype(np.int64)
+        idx = len(self.table)
+        self.table.append([arr.dtype.str, self.offset, int(arr.shape[0])])
+        raw = arr.tobytes()
+        self.parts.append(raw)
+        self.offset += len(raw)
+        return idx
+
+    def add_tail(self, raw: bytes) -> list[int]:
+        span = [self.tail_offset, len(raw)]
+        self.tail_parts.append(raw)
+        self.tail_offset += len(raw)
+        return span
+
+
+def _encode_indexed(values: list, blob: _BlobWriter) -> dict:
+    """Encode a per-op list drawn from a small distinct set (opcode
+    bases, unit values) as a header-side table + one index byte per op
+    in the strings tail (u16 when the table overflows a byte)."""
+    table: list = []
+    index: dict = {}
+    ids: list[int] = []
+    for v in values:
+        i = index.get(v)
+        if i is None:
+            i = index[v] = len(table)
+            table.append(v)
+        ids.append(i)
+    if len(table) <= 256:
+        raw, width = bytes(ids), 1
+    else:
+        raw, width = b"".join(i.to_bytes(2, "little") for i in ids), 2
+    return {"table": table, "span": blob.add_tail(raw), "width": width}
+
+
+def _decode_indexed(doc: dict, tail: memoryview, intern=None) -> list:
+    table = doc["table"]
+    if intern is not None:
+        table = [v if v is None else intern(v) for v in table]
+    off, length = doc["span"]
+    raw = bytes(tail[off:off + length])
+    if doc["width"] == 2:
+        return [
+            table[int.from_bytes(raw[i:i + 2], "little")]
+            for i in range(0, len(raw), 2)
+        ]
+    return [table[b] for b in raw]
+
+
+def _steps_to_doc(steps: list, blob: _BlobWriter) -> list:
+    from tpusim.trace.format import _collective_to_json
+
+    out = []
+    for step in steps:
+        kind = step[0]
+        if kind == "run":
+            (_, lo, hi, emit, hbm_idx, flops_idx, mxu_idx,
+             ugroups, ogroups) = step
+            out.append([
+                "run", lo, hi,
+                blob.add(emit), blob.add(hbm_idx),
+                blob.add(flops_idx), blob.add(mxu_idx),
+                [[u, blob.add(idx)] for u, idx in ugroups],
+                [[b, blob.add(idx)] for b, idx in ogroups],
+            ])
+        elif kind == "coll":
+            _, i, name, base, info, is_start = step
+            out.append([
+                "coll", i, name, base, _collective_to_json(info), is_start,
+            ])
+        elif kind == "cond":
+            _, i, name, base, branches = step
+            out.append(["cond", i, name, base, list(branches)])
+        else:
+            # crun/while/call/done/dma: plain JSON scalars throughout
+            out.append(list(step))
+    return out
+
+
+def _steps_from_doc(doc: list, arrays: list) -> list:
+    from tpusim.trace.format import _collective_from_json
+
+    steps = []
+    for step in doc:
+        kind = step[0]
+        if kind == "run":
+            (_, lo, hi, a_emit, a_hbm, a_flops, a_mxu,
+             ugroups, ogroups) = step
+            steps.append((
+                "run", lo, hi,
+                arrays[a_emit], arrays[a_hbm],
+                arrays[a_flops], arrays[a_mxu],
+                [(u, arrays[a]) for u, a in ugroups],
+                [(b, arrays[a]) for b, a in ogroups],
+            ))
+        elif kind == "coll":
+            _, i, name, base, info, is_start = step
+            steps.append((
+                "coll", i, name, base, _collective_from_json(info),
+                is_start,
+            ))
+        elif kind == "cond":
+            _, i, name, base, branches = step
+            steps.append(("cond", i, name, base, tuple(branches)))
+        else:
+            steps.append(tuple(step))
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# The store
+# ---------------------------------------------------------------------------
+
+
+class CompileStore:
+    """Durable disk tier for :class:`~tpusim.fastpath.compile.
+    CompiledModule` instances; see the module docstring.
+
+    One instance may serve many engines/threads — counters are
+    cumulative, and the disk protocol (whole-record atomic publish,
+    delete-tolerant reads) is the same one the result cache proved safe
+    under a daemon + N forked workers."""
+
+    def __init__(
+        self,
+        disk_dir: str | Path,
+        durable: bool = False,
+        quota_bytes: int | None = None,
+        quota_entries: int | None = None,
+    ):
+        self.disk_dir = Path(disk_dir)
+        self.durable = bool(durable)
+        self.quota_bytes = int(quota_bytes) if quota_bytes else None
+        self.quota_entries = int(quota_entries) if quota_entries else None
+        self._lock = threading.Lock()
+        self._disk_bytes_est: int | None = None
+        self._disk_entries_est = 0
+        self._model_version: str | None = None
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.errors = 0
+        self.quarantined = 0
+
+    def model_version(self) -> str:
+        # composite timing+parser stamp, same derivation as the result
+        # cache's (a compiled column is a parser-AND-model artifact)
+        if self._model_version is None:
+            from tpusim.perf.cache import parser_version
+            from tpusim.timing.model_version import model_version
+
+            self._model_version = f"{model_version()}+{parser_version()}"
+        return self._model_version
+
+    def path_for(self, key: str) -> Path:
+        from tpusim.perf.cache import _sha
+
+        return self.disk_dir / f"{_sha(key)}{COMPILE_RECORD_SUFFIX}"
+
+    # -- load ----------------------------------------------------------------
+
+    def load(self, key: str, module, engine):
+        """Rebuild a CompiledModule from the record for ``key``, or None
+        (miss / stale / quarantined-corrupt)."""
+        path = self.path_for(key)
+        try:
+            cm = self._read(path, key, module, engine)
+        except FileNotFoundError:
+            # no record yet, or a peer's GC freed it mid-lookup: a
+            # plain miss by the store concurrency contract
+            with self._lock:
+                self.misses += 1
+            return None
+        except (ValueError, KeyError, TypeError, IndexError, OSError,
+                json.JSONDecodeError) as e:
+            with self._lock:
+                self.errors += 1
+            from tpusim.guard.store import quarantine_record
+
+            if quarantine_record(path):
+                with self._lock:
+                    self.quarantined += 1
+            warnings.warn(
+                f"tpusim.fastpath: corrupt compiled-module record {path} "
+                f"({type(e).__name__}: {e}); quarantined, recompiling",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            cm = None
+        with self._lock:
+            if cm is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if cm is not None and (
+            self.quota_bytes is not None or self.quota_entries is not None
+        ):
+            # LRU recency lives in the mtime (guard's GC contract);
+            # un-governed stores skip the syscall, like the result
+            # cache's L1 — nothing will ever evict by age there
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+        return cm
+
+    def _read(self, path: Path, key: str, module, engine):
+        import mmap as _mmap
+
+        from tpusim.fastpath.compile import (
+            CompiledComputation, CompiledModule,
+        )
+
+        np = _np()
+        with open(path, "rb") as f:
+            try:
+                mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            except ValueError:
+                raise ValueError("record is empty") from None
+        if len(mm) < _HDR_FIXED or mm[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("bad magic")
+        hdr_len = int.from_bytes(mm[len(_MAGIC):_HDR_FIXED], "little")
+        if hdr_len <= 0 or _HDR_FIXED + hdr_len > len(mm):
+            raise ValueError("header length out of bounds")
+        header = json.loads(mm[_HDR_FIXED:_HDR_FIXED + hdr_len])
+        if header.get("format_version") != COMPILE_STORE_FORMAT_VERSION:
+            return None  # older layout: stale, not corrupt
+        if header.get("key") != key:
+            raise ValueError("stored key mismatch (hash collision?)")
+        if header.get("model_version") != self.model_version():
+            return None  # stale: model/parser bumped under the same name
+        blob_start = _HDR_FIXED + hdr_len
+        blob_start += (-blob_start) % 8
+        tail_start = blob_start + int(header["blob_bytes"])
+        if tail_start + int(header["tail_bytes"]) > len(mm):
+            raise ValueError("truncated column blob")
+        tail = memoryview(mm)[
+            tail_start:tail_start + int(header["tail_bytes"])
+        ]
+
+        intp = np.dtype(np.intp)
+        arrays = []
+        for dt, off, count in header["arrays"]:
+            arr = np.frombuffer(
+                mm, dtype=dt, count=count, offset=blob_start + off
+            )
+            if arr.dtype.kind == "i" and arr.dtype != intp:
+                arr = arr.astype(intp)
+            arrays.append(arr)
+
+        lean = bool(header["lean"])
+        cm = CompiledModule(
+            module, engine.cost, engine.config, lean=lean,
+            release_ir=lean,
+        )
+        import sys as _sys
+
+        intern = _sys.intern
+        for cdoc in header["comps"]:
+            cols = {
+                attr: arrays[cdoc["cols"][attr]] for attr in _COLUMN_ATTRS
+            }
+            names = None
+            if cdoc["names"] is not None:
+                off, length = cdoc["names"]
+                text = bytes(tail[off:off + length]).decode()
+                names = text.split("\n") if text else []
+            cc = CompiledComputation(
+                name=cdoc["name"],
+                n_ops=int(cdoc["n_ops"]),
+                names=names,
+                bases=_decode_indexed(cdoc["bases"], tail, intern=intern),
+                units=_decode_indexed(cdoc["units"], tail),
+                cycles=cols["cycles"], compute=cols["compute"],
+                hbm=cols["hbm"], vmem=cols["vmem"],
+                hrs=cols["hrs"], vrs=cols["vrs"],
+                flops=cols["flops"], mxu=cols["mxu"],
+                trans=cols["trans"], ici_bytes=cols["ici_bytes"],
+                steps=_steps_from_doc(cdoc["steps"], arrays),
+                any_vmem=bool(cdoc["any_vmem"]),
+            )
+            cm.comps[cc.name] = cc
+        mod_doc = header.get("module") or {}
+        cm.entry_name = mod_doc.get("entry_name")
+        cm.residency = mod_doc.get("residency")
+        cm.residency_kind = mod_doc.get("residency_kind")
+        cm.peak_live = mod_doc.get("peak_live")
+        return cm
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, cm, key: str) -> bool:
+        """Serialize every compiled computation of ``cm`` and publish
+        the record atomically.  Returns False on (warned) failure."""
+        try:
+            payload = self._serialize(cm, key)
+        except (ValueError, TypeError) as e:  # pragma: no cover - defensive
+            warnings.warn(
+                f"tpusim.fastpath: compiled-module record for {key!r} "
+                f"did not serialize ({type(e).__name__}: {e}); "
+                f"continuing undurable",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return False
+        path = self.path_for(key)
+        tmp = path.parent / (
+            f"{path.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+        )
+        try:
+            self.disk_dir.mkdir(parents=True, exist_ok=True)
+            governed = (
+                self.quota_bytes is not None
+                or self.quota_entries is not None
+            )
+            old_size = 0
+            if governed:
+                try:
+                    old_size = path.stat().st_size
+                except OSError:
+                    old_size = 0
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                if self.durable:
+                    f.flush()
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if self.durable:
+                dir_fd = os.open(self.disk_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+        except OSError as e:
+            with self._lock:
+                self.errors += 1
+            warnings.warn(
+                f"tpusim.fastpath: compiled-module write failed under "
+                f"{self.disk_dir} ({e}); continuing undurable",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self.stores += 1
+        if governed:
+            self._quota_gc(path, old_size)
+        return True
+
+    def _serialize(self, cm, key: str) -> bytes:
+        blob = _BlobWriter()
+        comps = []
+        for name, cc in list(cm.comps.items()):
+            comps.append({
+                "name": name,
+                "n_ops": cc.n_ops,
+                "any_vmem": bool(cc.any_vmem),
+                "names": (
+                    None if cc.names is None
+                    else blob.add_tail("\n".join(cc.names).encode())
+                ),
+                "bases": _encode_indexed(cc.bases, blob),
+                "units": _encode_indexed(cc.units, blob),
+                "steps": _steps_to_doc(cc.steps, blob),
+                "cols": {
+                    attr: blob.add(getattr(cc, attr))
+                    for attr in _COLUMN_ATTRS
+                },
+            })
+        header = json.dumps({
+            "format_version": COMPILE_STORE_FORMAT_VERSION,
+            "key": key,
+            "model_version": self.model_version(),
+            "lean": bool(cm.lean),
+            "module": {
+                "entry_name": cm.entry_name,
+                "residency": cm.residency,
+                "residency_kind": cm.residency_kind,
+                "peak_live": cm.peak_live,
+            },
+            "comps": comps,
+            "arrays": blob.table,
+            "blob_bytes": blob.offset,
+            "tail_bytes": blob.tail_offset,
+        }).encode()
+        pad = (-(_HDR_FIXED + len(header))) % 8
+        return b"".join([
+            _MAGIC,
+            len(header).to_bytes(8, "little"),
+            header,
+            b"\0" * pad,
+            *blob.parts,
+            *blob.tail_parts,
+        ])
+
+    # -- quota ---------------------------------------------------------------
+
+    def _quota_gc(self, new_path: Path, old_size: int) -> None:
+        """Same estimate-then-GC discipline as the result cache: the GC
+        itself (guard's :func:`gc_store`) is tier-blind — it bounds the
+        whole store directory, result and compiled records together."""
+        try:
+            size = new_path.stat().st_size
+        except OSError:
+            size = 0
+        from tpusim.guard.store import _record_paths, gc_store
+
+        with self._lock:
+            if self._disk_bytes_est is None:
+                paths = _record_paths(self.disk_dir)
+                self._disk_bytes_est = 0
+                for p in paths:
+                    try:
+                        self._disk_bytes_est += p.stat().st_size
+                    except OSError:
+                        pass
+                self._disk_entries_est = len(paths)
+            else:
+                self._disk_bytes_est += size - old_size
+                if old_size == 0:
+                    self._disk_entries_est += 1
+            over = (
+                (self.quota_bytes is not None
+                 and self._disk_bytes_est > self.quota_bytes)
+                or (self.quota_entries is not None
+                    and self._disk_entries_est > self.quota_entries)
+            )
+        if not over:
+            return
+        res = gc_store(
+            self.disk_dir, quota_bytes=self.quota_bytes,
+            max_entries=self.quota_entries,
+        )
+        with self._lock:
+            self._disk_bytes_est = res.remaining_bytes
+            self._disk_entries_est = res.remaining_entries
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats_dict(self) -> dict[str, float]:
+        """Counters for the ``fastpath_`` stats block / serve metrics
+        (ride ONLY when a compile store is active — the faults_*
+        discipline)."""
+        with self._lock:
+            return {
+                "store_hits": self.hits,
+                "store_misses": self.misses,
+                "store_writes": self.stores,
+                "store_errors": self.errors,
+                "store_quarantined": self.quarantined,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Record inspection (the `tpusim cache` / verify_store side)
+# ---------------------------------------------------------------------------
+
+
+def read_record_header(path: str | Path) -> dict:
+    """Parse and structurally validate one ``.cmod`` record's header
+    (raises ``ValueError`` on anything a loader would refuse).  Used by
+    :func:`tpusim.guard.store.verify_store` and ``tpusim cache stats``;
+    reads ONLY the header bytes — compiled records are the large tier,
+    and the boot integrity sweep must not read whole column blobs just
+    to check their framing (the blob gets a size-vs-stat bounds check,
+    nothing more)."""
+    path = Path(path)
+    with open(path, "rb") as f:
+        fixed = f.read(_HDR_FIXED)
+        if len(fixed) < _HDR_FIXED or fixed[: len(_MAGIC)] != _MAGIC:
+            raise ValueError("bad magic")
+        hdr_len = int.from_bytes(fixed[len(_MAGIC):], "little")
+        total = os.fstat(f.fileno()).st_size
+        if hdr_len <= 0 or _HDR_FIXED + hdr_len > total:
+            raise ValueError("header length out of bounds")
+        raw_header = f.read(hdr_len)
+    if len(raw_header) < hdr_len:
+        raise ValueError("short header read")
+    header = json.loads(raw_header)
+    if not isinstance(header, dict):
+        raise ValueError("header is not an object")
+    for field in ("format_version", "key", "model_version", "comps",
+                  "arrays", "blob_bytes", "tail_bytes"):
+        if field not in header:
+            raise ValueError(f"header missing {field!r}")
+    from tpusim.perf.cache import _sha
+
+    if path.name != f"{_sha(str(header['key']))}{COMPILE_RECORD_SUFFIX}":
+        raise ValueError("stored key does not match the record's name")
+    blob_start = _HDR_FIXED + hdr_len
+    blob_start += (-blob_start) % 8
+    end = blob_start + int(header["blob_bytes"]) + int(header["tail_bytes"])
+    if end > total:
+        raise ValueError("truncated column blob")
+    return header
+
+
+# ---------------------------------------------------------------------------
+# Process-wide activation
+# ---------------------------------------------------------------------------
+
+_STORE: CompileStore | None = None
+_STORE_EXPLICIT = False
+#: (env value, store) pair backing $TPUSIM_COMPILE_CACHE resolution
+_ENV_STORE: tuple[str, CompileStore] | None = None
+_ACT_LOCK = threading.Lock()
+
+
+def set_compile_store(store: CompileStore | None) -> CompileStore | None:
+    """Install (or, with None, deactivate) the process-wide compiled
+    disk tier.  An explicit set always wins over the environment."""
+    global _STORE, _STORE_EXPLICIT
+    with _ACT_LOCK:
+        _STORE = store
+        _STORE_EXPLICIT = True
+    return store
+
+
+def get_compile_store() -> CompileStore | None:
+    """The active store: the explicitly installed one, else one resolved
+    from ``$TPUSIM_COMPILE_CACHE`` (a directory path; forked workers and
+    bench subprocesses inherit activation this way)."""
+    global _ENV_STORE
+    if _STORE_EXPLICIT:
+        return _STORE
+    env = os.environ.get("TPUSIM_COMPILE_CACHE")
+    if not env:
+        return None
+    with _ACT_LOCK:
+        if _ENV_STORE is None or _ENV_STORE[0] != env:
+            _ENV_STORE = (env, CompileStore(env))
+        return _ENV_STORE[1]
+
+
+def compile_store_active() -> bool:
+    return get_compile_store() is not None
+
+
+def as_compile_store(
+    spec,
+    durable: bool = False,
+    quota_bytes: int | None = None,
+    quota_entries: int | None = None,
+    activate: bool = True,
+) -> CompileStore | None:
+    """Coerce the ``--compile-cache`` flag family to a store and (by
+    default) install it process-wide: None/False → leave activation
+    untouched; True → the default cache dir; a path → a store there; an
+    existing :class:`CompileStore` passes through."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, CompileStore):
+        store = spec
+    else:
+        if spec is True:
+            from tpusim.perf.cache import DEFAULT_CACHE_DIR
+
+            spec = DEFAULT_CACHE_DIR
+        store = CompileStore(
+            spec, durable=durable, quota_bytes=quota_bytes,
+            quota_entries=quota_entries,
+        )
+    if quota_bytes is not None:
+        store.quota_bytes = int(quota_bytes)
+    if quota_entries is not None:
+        store.quota_entries = int(quota_entries)
+    if activate:
+        set_compile_store(store)
+    return store
+
+
+def maybe_persist_compiled(cm) -> None:
+    """Publish ``cm``'s columns if a store is active, the module was
+    eligible for the shared tier, and a pricing walk compiled anything
+    new since the last publish (the fastpath calls this after every
+    successful ``price_module``)."""
+    key = getattr(cm, "_store_key", None)
+    if key is None or not getattr(cm, "_store_dirty", False):
+        return
+    store = get_compile_store()
+    if store is None:
+        return
+    if store.save(cm, key):
+        cm._store_dirty = False

@@ -36,6 +36,7 @@ from pathlib import Path
 __all__ = [
     "GCResult",
     "QUARANTINE_DIR",
+    "RECORD_PATTERNS",
     "StoreStats",
     "VerifyResult",
     "clear_store",
@@ -92,9 +93,20 @@ def format_size(nbytes: float) -> str:
     return f"{nbytes:.1f}TiB"  # pragma: no cover - unreachable
 
 
+#: the store's record tiers: engine-result records (PR 4, JSON) and
+#: compiled-module records (the fastpath's durable tier, binary .cmod).
+#: One directory, one quota, one GC — eviction is whole-record and
+#: tier-blind (mtime LRU ranks a cold compiled module against a cold
+#: result on equal footing; both rebuild from a recompute)
+RECORD_PATTERNS = ("*.json", "*.cmod")
+
+
 def _record_paths(directory: Path) -> list[Path]:
     try:
-        return sorted(directory.glob("*.json"))
+        out: list[Path] = []
+        for pattern in RECORD_PATTERNS:
+            out.extend(directory.glob(pattern))
+        return sorted(out)
     except OSError:
         return []
 
@@ -113,11 +125,16 @@ def store_bytes(directory: str | Path) -> int:
 
 @dataclass
 class StoreStats:
-    """``tpusim cache stats`` — one scan's summary."""
+    """``tpusim cache stats`` — one scan's summary, split by tier
+    (engine-result records vs compiled-module records)."""
 
     directory: str
     entries: int = 0
     bytes: int = 0
+    result_entries: int = 0
+    result_bytes: int = 0
+    compiled_entries: int = 0
+    compiled_bytes: int = 0
     quarantined: int = 0
     tmp_files: int = 0
     model_versions: dict[str, int] = field(default_factory=dict)
@@ -127,6 +144,10 @@ class StoreStats:
         out = [
             f"store: {self.directory}",
             f"  entries: {self.entries} ({format_size(self.bytes)})",
+            f"    results:  {self.result_entries} "
+            f"({format_size(self.result_bytes)})",
+            f"    compiled: {self.compiled_entries} "
+            f"({format_size(self.compiled_bytes)})",
             f"  quarantined: {self.quarantined}",
             f"  staging tmp files: {self.tmp_files}",
         ]
@@ -135,6 +156,18 @@ class StoreStats:
         for mv, n in sorted(self.model_versions.items()):
             out.append(f"  model_version {mv}: {n} record(s)")
         return out
+
+
+def _record_model_version(p: Path) -> str:
+    """Best-effort model_version of one record, either tier."""
+    try:
+        if p.suffix == ".cmod":
+            from tpusim.fastpath.store import read_record_header
+
+            return str(read_record_header(p).get("model_version", "?"))
+        return str(json.loads(p.read_text()).get("model_version", "?"))
+    except (OSError, ValueError, json.JSONDecodeError, AttributeError):
+        return "<unreadable>"
 
 
 def scan_store(directory: str | Path) -> StoreStats:
@@ -148,16 +181,17 @@ def scan_store(directory: str | Path) -> StoreStats:
             continue
         stats.entries += 1
         stats.bytes += st.st_size
+        if p.suffix == ".cmod":
+            stats.compiled_entries += 1
+            stats.compiled_bytes += st.st_size
+        else:
+            stats.result_entries += 1
+            stats.result_bytes += st.st_size
         age = now - st.st_mtime
         if stats.oldest_age_s is None or age > stats.oldest_age_s:
             stats.oldest_age_s = age
-        try:
-            mv = json.loads(p.read_text()).get("model_version", "?")
-        except (OSError, json.JSONDecodeError, AttributeError):
-            mv = "<unreadable>"
-        stats.model_versions[str(mv)] = (
-            stats.model_versions.get(str(mv), 0) + 1
-        )
+        mv = _record_model_version(p)
+        stats.model_versions[mv] = stats.model_versions.get(mv, 0) + 1
     qdir = d / QUARANTINE_DIR
     if qdir.is_dir():
         stats.quarantined = sum(1 for _ in qdir.iterdir())
@@ -253,13 +287,15 @@ def gc_store(
 class VerifyResult:
     checked: int = 0
     ok: int = 0
+    compiled_checked: int = 0
     quarantined_corrupt: int = 0
     quarantined_stale_format: int = 0
     stale_model: int = 0
 
     def lines(self) -> list[str]:
         return [
-            f"  checked: {self.checked}",
+            f"  checked: {self.checked} "
+            f"({self.compiled_checked} compiled-tier)",
             f"  ok: {self.ok}",
             f"  quarantined (corrupt): {self.quarantined_corrupt}",
             f"  quarantined (stale format): "
@@ -272,16 +308,21 @@ class VerifyResult:
 def verify_store(
     directory: str | Path, model_version: str | None = None,
 ) -> VerifyResult:
-    """The startup integrity sweep: parse every record; quarantine
-    anything corrupt (unparsable, wrong shape, key/hash mismatch) or in
-    a stale format version.  Records from an older *model* version are
-    well-formed and merely unreachable (the model version is baked into
-    every lookup key), so they are counted but left for GC to age out.
+    """The startup integrity sweep: parse every record — engine-result
+    (``.json``) and compiled-module (``.cmod``) tiers alike — and
+    quarantine anything corrupt (unparsable, wrong shape, key/hash
+    mismatch, truncated column blob) or in a stale format version.
+    Records from an older *model* version are well-formed and merely
+    unreachable (the model version is baked into every lookup key), so
+    they are counted but left for GC to age out.
 
     ``model_version`` defaults to the live cache's current composite
     stamp (timing model + parser), so the daemon's startup sweep counts
     stale records without the caller re-deriving it; pass ``""`` to
     skip the staleness count entirely."""
+    from tpusim.fastpath.store import (
+        COMPILE_STORE_FORMAT_VERSION, read_record_header,
+    )
     from tpusim.perf.cache import CACHE_FORMAT_VERSION, parser_version
     from tpusim.timing.model_version import model_version as _live_mv
 
@@ -292,22 +333,34 @@ def verify_store(
     res = VerifyResult()
     for p in _record_paths(d):
         res.checked += 1
+        compiled = p.suffix == ".cmod"
+        if compiled:
+            res.compiled_checked += 1
         try:
-            doc = json.loads(p.read_text())
-            if not isinstance(doc, dict):
-                raise ValueError("record is not an object")
-            fmt = doc.get("format_version")
-            if fmt != CACHE_FORMAT_VERSION:
+            if compiled:
+                doc = read_record_header(p)
+                fmt_ok = (
+                    doc.get("format_version")
+                    == COMPILE_STORE_FORMAT_VERSION
+                )
+            else:
+                doc = json.loads(p.read_text())
+                if not isinstance(doc, dict):
+                    raise ValueError("record is not an object")
+                fmt_ok = doc.get("format_version") == CACHE_FORMAT_VERSION
+            if not fmt_ok:
                 if quarantine_record(p):
                     res.quarantined_stale_format += 1
                 continue
-            for key in ("key", "model_version", "result"):
+            for key in ("key", "model_version"):
                 if key not in doc:
                     raise ValueError(f"record missing {key!r}")
-            if not isinstance(doc["result"], dict):
+            if not compiled and not isinstance(doc.get("result"), dict):
                 raise ValueError("result is not an object")
         except FileNotFoundError:
             res.checked -= 1  # raced a concurrent delete: not ours
+            if compiled:
+                res.compiled_checked -= 1
             continue
         except (ValueError, json.JSONDecodeError, OSError, TypeError):
             if quarantine_record(p):
@@ -324,7 +377,7 @@ def clear_store(directory: str | Path) -> int:
     Returns the number of files removed."""
     d = Path(directory)
     removed = 0
-    for pattern in ("*.json", "*.tmp"):
+    for pattern in (*RECORD_PATTERNS, "*.tmp"):
         for p in d.glob(pattern):
             try:
                 p.unlink()

@@ -260,6 +260,14 @@ class TraceOp:
         return f"TraceOp({self.name}: {self.opcode} -> {self.result})"
 
 
+#: process-wide count of ops added to computations — the observable
+#: behind the durable compile tier's cold-path contract ("a warm store
+#: prices with ZERO Python IR construction", asserted by the cold-serve
+#: CI smoke over /metrics).  A mutable holder because hot parse loops
+#: must not pay an import or a function call to maintain it.
+ir_build_counter = {"ops": 0}
+
+
 @dataclass
 class Computation:
     """One HLO computation: a named list of ops, in program (schedule) order."""
@@ -273,6 +281,7 @@ class Computation:
     def add(self, op: TraceOp) -> None:
         self.ops.append(op)
         self._by_name[op.name] = op
+        ir_build_counter["ops"] += 1
 
     def op(self, name: str) -> TraceOp:
         return self._by_name[name]

@@ -61,6 +61,7 @@ __all__ = [
     "clear_compiled_cache",
     "compiled_cache_stats",
     "compiled_for",
+    "compiled_key_str",
     "set_compiled_cache_max",
     "config_fingerprint",
     "module_fingerprint",
@@ -590,8 +591,14 @@ class ResultCache:
             size = 0
         with self._lock:
             if self._disk_bytes_est is None:
+                # tier-inclusive seed: the quota governs the WHOLE
+                # store dir (result + compiled records — guard's
+                # RECORD_PATTERNS is the one tier definition), so the
+                # estimate must start from everything GC would scan
+                from tpusim.guard.store import _record_paths
+
                 total = count = 0
-                for p in self.disk_dir.glob("*.json"):
+                for p in _record_paths(self.disk_dir):
                     try:
                         total += p.stat().st_size
                         count += 1
@@ -751,6 +758,15 @@ def _compiled_key(module, config: SimConfig, lean: bool) -> tuple | None:
     )
 
 
+def compiled_key_str(key: tuple) -> str:
+    """The durable-tier string form of a compiled-module key (the same
+    five components the in-memory tier keys on, in the same order)."""
+    mfp, platform, cfg_fp, mv, lean = key
+    return "|".join((
+        mfp, f"p={platform}", cfg_fp, mv, "lean" if lean else "full",
+    ))
+
+
 def compiled_for(module, engine):
     """The fastpath's one compile per (module content, config): return
     a cached :class:`tpusim.fastpath.compile.CompiledModule` or mint
@@ -803,6 +819,9 @@ def compiled_for(module, engine):
             pass
         return cm
 
+    from tpusim.fastpath.store import get_compile_store
+
+    store = get_compile_store()
     with _compiled_lock:
         cm = _COMPILED.get(key)
         if cm is not None:
@@ -813,10 +832,30 @@ def compiled_for(module, engine):
         # (same content hash by key construction — the columns
         # transfer) so not-yet-reached computations can still compile
         cm.bind(module, engine.cost)
+        if store is not None and cm._store_key is None:
+            # a store activated after this instance was minted: adopt
+            # it, so columns still publish at the next pricing walk
+            cm._store_key = compiled_key_str(key)
         return cm
+    if store is not None:
+        # durable tier (tpusim.fastpath.store): mmap-load the columns
+        # a peer process (or a previous life of this one) compiled —
+        # BEFORE any lazy compile, which is what lets a warm store
+        # price a lazily-loaded module with zero IR construction
+        keystr = compiled_key_str(key)
+        cm = store.load(keystr, module, engine)
+        if cm is not None:
+            cm._store_key = keystr
+            with _compiled_lock:
+                _COMPILED[key] = cm
+                while len(_COMPILED) > COMPILED_CACHE_MAX:
+                    _COMPILED.popitem(last=False)
+            return cm
     cm = compile_module(
         module, engine.cost, engine.config, lean=lean, release_ir=lean,
     )
+    if store is not None:
+        cm._store_key = compiled_key_str(key)
     with _compiled_lock:
         _compiled_misses += 1
         _COMPILED[key] = cm
@@ -848,12 +887,25 @@ def set_compiled_cache_max(max_entries: int) -> None:
 
 def compiled_cache_stats() -> dict[str, float]:
     """Counters for the ``fastpath_`` stats block (stamped by the
-    driver only when a pricing backend was explicitly requested)."""
-    return {
+    driver only when a pricing backend was explicitly requested or a
+    durable compile store is active).  The ``store_*`` keys ride only
+    in the latter case — the faults_* discipline at sub-key grain."""
+    out = {
         "compile_hits": _compiled_hits,
         "compile_misses": _compiled_misses,
         "compiled_modules": len(_COMPILED),
     }
+    from tpusim.fastpath.store import get_compile_store
+
+    store = get_compile_store()
+    if store is not None:
+        out.update(store.stats_dict())
+        # the cold-path contract's observable: how many IR ops this
+        # process has built (a warm store holds it at zero)
+        from tpusim.ir import ir_build_counter
+
+        out["ir_ops_built"] = ir_build_counter["ops"]
+    return out
 
 
 # ---------------------------------------------------------------------------

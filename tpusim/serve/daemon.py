@@ -92,6 +92,26 @@ SERVE_FORMAT_VERSION = 1
 DEFAULT_MAX_REQUEST_BYTES = 8 * 1024 * 1024
 
 
+def _prewarm_pricing_stack() -> None:
+    """Pull the request path's one-time costs forward to boot.
+
+    A cold first request used to pay them inside its own latency
+    budget: the pricing-backend resolution (a numpy import + a native
+    dlopen, ~0.5 s on a cold container) and the lazy imports the
+    simulate path performs (driver, faults, analysis passes — tens of
+    ms of bytecode work when no .pyc is cached).  Called at daemon
+    start and worker boot; everything here is idempotent."""
+    from tpusim.fastpath.price import resolve_backend
+
+    resolve_backend(None)
+    import tpusim.analysis.config_passes  # noqa: F401
+    import tpusim.faults  # noqa: F401
+    import tpusim.sim.driver  # noqa: F401
+    from tpusim.timing.model_version import model_version
+
+    model_version()  # memoized source-hash pass
+
+
 class _Handler(BaseHTTPRequestHandler):
     """Protocol-only; all policy lives in the daemon's layers."""
 
@@ -602,6 +622,7 @@ class ServeDaemon:
         cache_quota=None,
         max_rss=None,
         max_worker_rss=None,
+        compile_cache=None,
         hot_cache=None,
         hot_quota_bytes=None,
         acceptor_index: int | None = None,
@@ -663,6 +684,22 @@ class ServeDaemon:
         self.cache_quota_bytes = parse_size(cache_quota)
         if self.cache_quota_bytes is not None:
             self.result_cache.quota_bytes = self.cache_quota_bytes
+        # tpusim.fastpath.store: --compile-cache mounts the durable
+        # compiled-module tier process-wide BEFORE the registry exists,
+        # so every trace the registry loads defers its parse — a cold
+        # first request against a warm store prices from mmapped
+        # columns with zero Python IR construction.  Durable writes
+        # (fsync-before-replace): this tier serves a fleet, and a
+        # daemon killed mid-publish must never leave a short-read
+        # record for its successors to warn about.
+        self.compile_store = None
+        if compile_cache is not None and compile_cache is not False:
+            from tpusim.fastpath.store import as_compile_store
+
+            self.compile_store = as_compile_store(
+                compile_cache, durable=True,
+                quota_bytes=self.cache_quota_bytes,
+            )
         self.registry = TraceRegistry(trace_root)
         self.worker = ServeWorker(
             self.registry, result_cache=self.result_cache, workers=workers,
@@ -712,6 +749,10 @@ class ServeDaemon:
                     ),
                     "cache_entries": int(cache_entries),
                     "cache_quota_bytes": self.cache_quota_bytes,
+                    "compile_cache_dir": (
+                        str(self.compile_store.disk_dir)
+                        if self.compile_store is not None else None
+                    ),
                     "chaos_hooks": bool(chaos_hooks),
                     # lets workers serialize the FINAL response body
                     # (byte-identical to _send_json's by construction)
@@ -822,6 +863,15 @@ class ServeDaemon:
         if self.supervisor is not None:
             for k, v in self.supervisor.stats_dict().items():
                 values[f"serve_{k}"] = v
+        # compile-cache effectiveness — only when the durable compiled
+        # tier is mounted (the faults_* discipline on /metrics too)
+        from tpusim.fastpath.store import get_compile_store
+
+        if get_compile_store() is not None:
+            from tpusim.perf.cache import compiled_cache_stats
+
+            for k, v in compiled_cache_stats().items():
+                values[f"fastpath_{k}"] = v
         # tpusim.guard gauges — only when guard features are active
         # (quota / watchdog / startup sweep), mirroring the report-key
         # discipline: an un-governed daemon's scrape is unchanged
@@ -1145,23 +1195,38 @@ class ServeDaemon:
     def start(self) -> "ServeDaemon":
         """Bind the listener and start serving on background threads.
         Returns self (so tests can ``ServeDaemon(...).start()``)."""
+        _prewarm_pricing_stack()
+        sweep_dirs = []
         if self.result_cache.disk_dir is not None \
                 and self.result_cache.disk_dir.is_dir():
+            sweep_dirs.append(self.result_cache.disk_dir)
+        if self.compile_store is not None \
+                and self.compile_store.disk_dir.is_dir() \
+                and self.compile_store.disk_dir not in sweep_dirs:
+            # a compiled tier mounted at its own dir gets the same boot
+            # sweep (verify_store is tier-aware; a shared dir is swept
+            # once and covers both record kinds)
+            sweep_dirs.append(self.compile_store.disk_dir)
+        for sweep_dir in sweep_dirs:
             # startup integrity sweep (tpusim.guard): quarantine corrupt
             # or stale-format records BEFORE the first request can trip
             # over them — a crashed peer's damage heals at boot, not one
             # warning at a time under traffic
             from tpusim.guard.store import verify_store
 
-            res = verify_store(self.result_cache.disk_dir)
-            self._guard_startup = {
-                "startup_records_checked": res.checked,
-                "startup_records_ok": res.ok,
-                "startup_quarantined": (
-                    res.quarantined_corrupt + res.quarantined_stale_format
-                ),
-                "startup_stale_model": res.stale_model,
-            }
+            res = verify_store(sweep_dir)
+            # accumulate: a daemon may sweep the result dir AND a
+            # separately-mounted compiled dir
+            for key, add in (
+                ("startup_records_checked", res.checked),
+                ("startup_records_ok", res.ok),
+                ("startup_quarantined",
+                 res.quarantined_corrupt + res.quarantined_stale_format),
+                ("startup_stale_model", res.stale_model),
+            ):
+                self._guard_startup[key] = (
+                    self._guard_startup.get(key, 0) + add
+                )
             if self.verbose and (
                 res.quarantined_corrupt or res.quarantined_stale_format
             ):

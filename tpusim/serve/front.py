@@ -126,6 +126,7 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
         cache_quota=settings.get("disk_quota"),
         max_rss=settings.get("max_rss"),
         max_worker_rss=settings.get("max_worker_rss"),
+        compile_cache=settings.get("compile_cache"),
         hot_cache=settings.get("hot_cache"),
         hot_quota_bytes=settings.get("hot_quota_bytes"),
         acceptor_index=index,

@@ -567,6 +567,21 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
     from tpusim.serve.registry import TraceRegistry
 
     disk_dir = settings.get("disk_cache_dir") or None
+    if settings.get("compile_cache_dir"):
+        # the durable compiled-module tier, same dir discipline as the
+        # shared L2: every worker loads columns a peer compiled and
+        # publishes durably (fsync-before-replace) for the fleet
+        from tpusim.fastpath.store import as_compile_store
+
+        as_compile_store(
+            settings["compile_cache_dir"], durable=True,
+            quota_bytes=settings.get("cache_quota_bytes"),
+        )
+    # pull the request path's one-time costs (numpy import, native
+    # dlopen, lazy pricing-stack imports) forward to worker boot
+    from tpusim.serve.daemon import _prewarm_pricing_stack
+
+    _prewarm_pricing_stack()
     registry = TraceRegistry(settings.get("trace_root"))
     cache = ResultCache(
         disk_dir=disk_dir,

@@ -148,6 +148,7 @@ class SimDriver:
         workers: int | None = None,
         pricing_backend: str | None = None,
         cancel=None,
+        compile_cache=None,
     ):
         self.config = config
         self.arch = config.arch
@@ -181,6 +182,19 @@ class SimDriver:
         # the report — the faults_* discipline, so default runs stay
         # key-identical)
         self.pricing_backend = pricing_backend
+        # tpusim.fastpath.store: the durable compiled-module tier (the
+        # --compile-cache flag family: a CompileStore, a dir path, or
+        # True for the default store dir).  Activation is process-wide
+        # — compiled_for consults it before any compile, price_module
+        # publishes after — so the driver's only jobs are coercion and
+        # stats stamping.  None leaves whatever is already active
+        # (a serve daemon activates at boot, workers inherit) untouched.
+        if compile_cache is not None and compile_cache is not False:
+            from tpusim.fastpath.store import as_compile_store
+
+            self.compile_store = as_compile_store(compile_cache)
+        else:
+            self.compile_store = None
 
     # ------------------------------------------------------------------
 
@@ -662,10 +676,14 @@ class SimDriver:
                 {"workers": workers, "parallel_segments": pool_segments},
                 prefix="pool_",
             )
-        if self.pricing_backend is not None:
+        from tpusim.fastpath.store import get_compile_store
+
+        if self.pricing_backend is not None or \
+                get_compile_store() is not None:
             # fastpath accounting rides the report ONLY when a backend
-            # was explicitly requested (the faults_*/cache_* discipline:
-            # default auto-fastpath runs stay key-identical, goldens
+            # was explicitly requested or a durable compile store is
+            # active (the faults_*/cache_* discipline: default
+            # auto-fastpath runs stay key-identical, goldens
             # unchanged).  The stamped name is what actually priced:
             # under obs instrumentation or op-granularity checkpoint/
             # resume the fastpath disengages and every run took the
@@ -721,6 +739,7 @@ def simulate_trace(
     pricing_backend: str | None = None,
     cancel=None,
     max_wall_s: float | None = None,
+    compile_cache=None,
 ) -> SimReport:
     """One-call CLI-style entry: load a trace dir, pick a config, replay.
 
@@ -750,11 +769,22 @@ def simulate_trace(
     (the ``--max-wall-s`` flag) make the replay cooperatively
     cancellable: a tripped token raises
     :class:`tpusim.guard.OperationCancelled` at the next command/op
-    boundary instead of pricing to completion."""
+    boundary instead of pricing to completion.  ``compile_cache`` (the
+    ``--compile-cache[=DIR]`` flag) mounts the durable compiled-module
+    tier before the trace loads, so the parse defers and a warm store
+    prices with zero IR construction."""
     from tpusim.timing.config import load_config
     from tpusim.trace.format import load_trace
 
     obs = obs if obs is not None else NULL_OBS
+    if compile_cache is not None and compile_cache is not False:
+        # activated BEFORE the parse span: load_trace defers IR
+        # construction exactly when the compiled tier may serve it
+        # (the coerced instance rides into the driver so it isn't
+        # re-coerced — counters are cumulative per instance)
+        from tpusim.fastpath.store import as_compile_store
+
+        compile_cache = as_compile_store(compile_cache)
     if max_wall_s is not None and cancel is None:
         from tpusim.guard.cancel import CancelToken
 
@@ -795,4 +825,5 @@ def simulate_trace(
             cfg, topology=topology, obs=obs, faults=faults,
             result_cache=result_cache, workers=workers,
             pricing_backend=pricing_backend, cancel=cancel,
+            compile_cache=compile_cache,
         ).run(pod)

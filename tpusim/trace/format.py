@@ -231,7 +231,10 @@ def select_module(pod: "PodTrace", want: str | None):
     )
 
 
-def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
+def load_trace(
+    path: str | Path, lenient: bool = False,
+    defer_parse: bool | None = None,
+) -> PodTrace:
     """Load a trace directory into a :class:`PodTrace` (modules parsed).
 
     ``lenient=True`` parses module text in salvage mode (malformed lines
@@ -239,19 +242,36 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
     strict parsing, which raises on the first corrupt line, stays the
     default.  Lenient mode always parses eagerly in Python: per-line
     recovery needs the reference parser, not the native scanner or the
-    lazy span index."""
+    lazy span index.
+
+    ``defer_parse=True`` builds every in-memory module lazily regardless
+    of size (computations parse on first IR access).  The default
+    (``None``) defers exactly when a durable compile store is active
+    (:func:`tpusim.fastpath.store.compile_store_active`): with a warm
+    store, pricing runs entirely from mmapped compiled columns and the
+    deferred parse never happens — the cold-path contract.  The lazy
+    module stamps the same ``content_hash`` the eager path does, so
+    every cache key is identical either way."""
     path = Path(path)
-    if not path.is_dir():
-        raise FileNotFoundError(f"trace directory not found: {path}")
-    if not (path / "modules").is_dir() and not (path / "commandlist.jsonl").exists():
+    # one directory read answers every existence question (a trace load
+    # under the durable compile tier is first-touch latency — per-file
+    # stat probes were a measurable slice of it)
+    try:
+        with os.scandir(path) as it:
+            root_names = {de.name for de in it}
+    except (FileNotFoundError, NotADirectoryError):
+        raise FileNotFoundError(
+            f"trace directory not found: {path}"
+        ) from None
+    if "modules" not in root_names and \
+            "commandlist.jsonl" not in root_names:
         raise FileNotFoundError(
             f"{path} is not a trace directory (no modules/ or "
             f"commandlist.jsonl)"
         )
-    meta_path = path / "meta.json"
     meta: dict = {}
-    if meta_path.exists():
-        with open(meta_path) as f:
+    if "meta.json" in root_names:
+        with open(path / "meta.json") as f:
             meta = json.load(f)
 
     from tpusim.trace.lazy import (
@@ -265,10 +285,29 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
     stream_threshold = int(os.environ.get(
         "TPUSIM_STREAM_THRESHOLD", STREAM_THRESHOLD_BYTES
     ))
+    if defer_parse is None and not lenient:
+        from tpusim.fastpath.store import compile_store_active
+
+        defer_parse = compile_store_active()
 
     pod = PodTrace(meta=meta)
     modules_dir = path / "modules"
-    if modules_dir.is_dir():
+    # one scandir pass instead of two sorted globs + a stat per module:
+    # DirEntry.stat() rides the directory read, and trace loading is
+    # the first-touch path the durable compile tier exists to shorten
+    plain: list[tuple[str, str, int]] = []
+    gzipped: list[tuple[str, str]] = []
+    try:
+        with os.scandir(modules_dir) as it:
+            for de in it:
+                n = de.name
+                if n.endswith(".hlo"):
+                    plain.append((n[:-4], de.path, de.stat().st_size))
+                elif n.endswith(".hlo.gz"):
+                    gzipped.append((n[: -len(".hlo.gz")], de.path))
+    except (FileNotFoundError, NotADirectoryError):
+        pass
+    if plain or gzipped:
         import gzip
 
         # str entries are in-memory module text; Path entries are
@@ -278,14 +317,15 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
         # in memory: per-line recovery and decompression both need the
         # full text anyway.
         entries: list[tuple[str, str | Path]] = []
-        for mp in sorted(modules_dir.glob("*.hlo")):
-            if not lenient and mp.stat().st_size >= stream_threshold:
-                entries.append((mp.stem, mp))
+        for key, fp, size in sorted(plain):
+            if not lenient and size >= stream_threshold:
+                entries.append((key, Path(fp)))
             else:
-                entries.append((mp.stem, mp.read_text()))
-        for mp in sorted(modules_dir.glob("*.hlo.gz")):
-            with gzip.open(mp, "rt") as f:
-                entries.append((mp.name[: -len(".hlo.gz")], f.read()))
+                with open(fp) as f:
+                    entries.append((key, f.read()))
+        for key, fp in sorted(gzipped):
+            with gzip.open(fp, "rt") as f:
+                entries.append((key, f.read()))
         for key, src in entries:
             # large modules parse lazily: the engine only materializes the
             # computations its schedule walk actually reaches
@@ -297,7 +337,7 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
                 from tpusim.trace.hlo_text import parse_hlo_module
 
                 mod = parse_hlo_module(src, name_hint=key, strict=False)
-            elif len(src) >= LAZY_THRESHOLD_BYTES:
+            elif defer_parse or len(src) >= LAZY_THRESHOLD_BYTES:
                 mod = parse_hlo_module_lazy(src, name_hint=key)
             else:
                 mod = parse_hlo_module_fast(src, name_hint=key)
@@ -320,7 +360,7 @@ def load_trace(path: str | Path, lenient: bool = False) -> PodTrace:
                     mod.meta.setdefault(k, meta[k])
 
     cl = path / "commandlist.jsonl"
-    if cl.exists():
+    if "commandlist.jsonl" in root_names:
         for cmd in parse_commandlist(cl):
             pod.device(cmd.device_id).commands.append(cmd)
     else:

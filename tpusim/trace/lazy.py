@@ -82,13 +82,21 @@ def _match_header(text: str, start: int = 0):
     return "partial" if limit >= len(text) else None
 _MODULE_RE = re.compile(r"^HloModule\s+(?P<name>[\w.\-]+),?(?P<attrs>[^\n]*)")
 
-# defining lines whose result layout pins vmem: `= dtype[dims]{...S(n)...}`
-_VMEM_DEF_RE = re.compile(
-    r"=\s*\(?\s*(?P<shapes>[a-z][a-z0-9]*\[[^\]]*\]\{[^}]*S\([1-9]\d*\)[^}]*\})"
-)
+# cheap filter for lines that can possibly pin vmem: a definition
+# (`=`) mentioning an `S(n)` layout anywhere.  Deliberately broad — a
+# tuple result whose FIRST leaf is an HBM alias but whose second leaf
+# is the S(1) allocation (async starts: (operand-alias, result, ...))
+# must still be scanned; the result-side leaf walk below decides what
+# actually counts (matmul_chain's slice-start ops were invisible to
+# the old first-leaf-anchored regex, under-counting residency vs the
+# engine's IR walk)
+_VMEM_DEF_RE = re.compile(r"=.*S\([1-9]\d*\)")
+#: every result leaf, positionally (layout optional — an HBM alias leaf
+#: still occupies its tuple slot, which the copy-start rule needs)
 _VMEM_SHAPE_RE = re.compile(
-    r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[^\]]*)\]\{[^}]*S\([1-9]\d*\)[^}]*\}"
+    r"(?P<dtype>[a-z][a-z0-9]*)\[(?P<dims>[^\]]*)\](?:\{(?P<layout>[^}]*)\})?"
 )
+_VMEM_SPACE_RE = re.compile(r"S\([1-9]\d*\)")
 #: opcode following the result: `...} opcode(` for array results,
 #: `...}) opcode(` for tuple results
 _OPCODE_AFTER_SHAPE_RE = re.compile(r"[})]\s*([a-z][\w\-]*)\(")
@@ -145,12 +153,25 @@ class _LazyComputationDict(dict):
 
 
 class LazyModuleTrace(ModuleTrace):
-    """A ModuleTrace whose computations parse on demand."""
+    """A ModuleTrace whose computations parse on demand.
+
+    Even the computation *span index* (one regex pass over the whole
+    text) builds lazily: a module priced from the durable compile tier
+    (warm ``.cmod`` columns carrying the entry name) never needs to
+    know where its computations live, so first-touch latency pays only
+    the module-header parse.  Anything that asks — ``entry_name`` on an
+    unindexed module, any ``computations`` access — forces the index
+    exactly once."""
+
+    #: class-level defaults so the entry_name property (a data
+    #: descriptor, which shadows the dataclass field) works during
+    #: ModuleTrace.__init__'s own assignment
+    _entry_name: str | None = None
+    _spans_cache: dict | None = None
 
     def __init__(self, text: str, name_hint: str = "module"):
         super().__init__(name=name_hint)
         self._text = text
-        self._spans: dict[str, tuple[int, int]] = {}
         self.computations = _LazyComputationDict(self)
 
         m = _MODULE_RE.search(text)
@@ -159,6 +180,27 @@ class LazyModuleTrace(ModuleTrace):
             from tpusim.trace.hlo_text import parse_module_attrs
 
             parse_module_attrs(m.group("attrs") or "", self.meta)
+
+    @property
+    def entry_name(self) -> str | None:
+        if self._entry_name is None and self._spans_cache is None:
+            self._build_spans()
+        return self._entry_name
+
+    @entry_name.setter
+    def entry_name(self, value) -> None:
+        self._entry_name = value
+
+    @property
+    def _spans(self) -> dict[str, tuple[int, int]]:
+        spans = self._spans_cache
+        if spans is None:
+            spans = self._build_spans()
+        return spans
+
+    def _build_spans(self) -> dict[str, tuple[int, int]]:
+        text = self._text
+        spans: dict[str, tuple[int, int]] = {}
         for hm in _COMP_HEAD_OPEN_RE.finditer(text):
             # only column-0 headers open computations (ops are indented)
             if hm.start() > 0 and text[hm.start() - 1] != "\n":
@@ -167,9 +209,11 @@ class LazyModuleTrace(ModuleTrace):
             if not isinstance(got, tuple):
                 continue
             name, is_entry = got
-            self._spans[name] = (hm.start(), _span_end(text, hm.start()))
+            spans[name] = (hm.start(), _span_end(text, hm.start()))
             if is_entry:
-                self.entry_name = name
+                self._entry_name = name
+        self._spans_cache = spans
+        return spans
 
     @property
     def parsed_count(self) -> int:
@@ -239,7 +283,11 @@ def _residency_scan(lines, entry_span: tuple[int, int] | None) -> float:
         if not dm:
             continue
         op_m = _OPCODE_AFTER_SHAPE_RE.search(line)
-        opcode = op_m.group(1) if op_m else ""
+        if op_m is None:
+            # no `shape opcode(` structure: a wrapped header line or
+            # degenerate text, never an allocating definition
+            continue
+        opcode = op_m.group(1)
         in_entry = (
             entry_span is not None
             and entry_span[0] <= idx < entry_span[1]
@@ -248,15 +296,18 @@ def _residency_scan(lines, entry_span: tuple[int, int] | None) -> float:
             # entry parameters are real allocations; nested ones alias
             if opcode != "parameter" or not in_entry:
                 continue
-        if opcode in ("while", "conditional") or opcode.endswith("-done"):
+        if opcode in ("while", "conditional", "call") \
+                or opcode.endswith("-done"):
             continue
         if opcode == "dynamic-update-slice" and not in_entry:
             continue
         # the opcode regex anchors on the result's closing brace —
         # keep it in the slice so the shape regex still matches
-        result_side = line[:op_m.start() + 1] if op_m else line
-        leaf_bytes = []
+        result_side = line[:op_m.start() + 1]
+        leaves = []  # (bytes, is_vmem) per result leaf, positionally
         for sm in _VMEM_SHAPE_RE.finditer(result_side):
+            layout = sm.group("layout")
+            vmem = bool(layout and _VMEM_SPACE_RE.search(layout))
             elems = 1
             dims = sm.group("dims").strip()
             if dims:
@@ -266,18 +317,22 @@ def _residency_scan(lines, entry_span: tuple[int, int] | None) -> float:
                     except ValueError:
                         elems = 0
                         break
-            leaf_bytes.append(
-                elems * _DTYPE_BYTES.get(sm.group("dtype"), 4)
+            leaves.append(
+                (elems * _DTYPE_BYTES.get(sm.group("dtype"), 4), vmem)
             )
         if opcode == "copy-start":
-            # result is (dst, src-alias, ctx): dst leads
-            total += leaf_bytes[0] if leaf_bytes else 0.0
+            # result is (dst, src-alias, ctx): only a vmem DST leaf is
+            # a new allocation — an S(1) src alias must not re-count
+            if leaves and leaves[0][1]:
+                total += leaves[0][0]
         elif opcode.endswith("-start"):
             # collective starts carry (operand-alias, result, ...):
             # count one buffer, not the alias pair
-            total += max(leaf_bytes, default=0.0)
+            total += max(
+                (b for b, vmem in leaves if vmem), default=0.0
+            )
         else:
-            total += sum(leaf_bytes)
+            total += sum(b for b, vmem in leaves if vmem)
     return total
 
 
