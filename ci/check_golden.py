@@ -759,6 +759,134 @@ def campaign_smoke(update: bool = False) -> dict:
     }
 
 
+#: the fleet smoke: a fixed-seed fleet digital-twin run on the
+#: llama_tiny fixture whose report must be BYTE-identical to the
+#: committed golden.  Seed 3 + pod_loss prob 0.9 was picked to exercise
+#: every contract piece at once: both pods crash (restart windows +
+#: elastic-recovery rows), the 30 req/s load point overruns the
+#: 4-deep queue (a real shedding window), and the 12 req/s frontier
+#: target lands a non-null pods-needed answer inside max_pods.
+#: tuned=False like every golden: the report must not shift when a
+#: live run refreshes the fit.
+FLEET_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+FLEET_SMOKE_GOLDEN = GOLDEN_DIR / "fleet_smoke.json"
+FLEET_SMOKE_SPEC = {
+    "name": "ci-fleet-smoke",
+    "seed": 3,
+    "pods": 2,
+    "arch": "v5p",
+    "chips": 8,
+    "tuned": False,
+    "horizon_s": 30.0,
+    "traffic": {
+        "shape": "bursty",
+        "load_points": [5.0, 30.0],
+        "burst": {"factor": 4.0, "fraction": 0.1, "period_s": 20.0},
+        "mix": [{"name": "chat", "weight": 3.0, "steps": 100},
+                {"name": "batch", "weight": 1.0, "steps": 400}],
+    },
+    "faults": {
+        "count": {"dist": "uniform", "min": 0, "max": 2},
+        "kinds": {"link_down": 1.0, "hbm_throttle": 1.0},
+        "scale": {"min": 0.4, "max": 0.9},
+        "window": {"min_s": 10.0, "max_s": 30.0},
+        "pod_loss": {"prob": 0.9},
+    },
+    "policies": {
+        "max_inflight": 1,
+        "queue_depth": 4,
+        "deadline_s": 0.5,
+        "restart_backoff_s": 5.0,
+    },
+    "slo": {"latency_ms": 400.0, "percentile": 95},
+    "frontier": {"target_rps": [12.0], "max_pods": 4},
+}
+
+
+def fleet_smoke(update: bool = False) -> dict:
+    """Fleet-twin determinism contract (tpusim.fleet):
+
+    1. the fixed-seed fleet run's report document must be byte-identical
+       to the committed golden (regen with ``--fleet-smoke --update``
+       after an intended model/report change);
+    2. the report must carry every contract piece: a goodput/p99 curve
+       with latency percentiles, per-policy loss attribution with a
+       LIVE shedding bucket, at least one pod loss with an
+       elastic-recovery row, energy per served request, and a non-null
+       capacity-frontier answer;
+    3. the healthy-path golden matrix must stay byte-identical as
+       always — a fleet run must not perturb healthy pricing.
+    Raises on violation."""
+    from tpusim.fleet import run_fleet
+
+    res = run_fleet(
+        FLEET_SMOKE_SPEC,
+        trace_path=FIXTURES / FLEET_SMOKE_FIXTURE,
+    )
+    got = json.dumps(res.doc, indent=1, sort_keys=True) + "\n"
+    if update:
+        GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+        FLEET_SMOKE_GOLDEN.write_text(got)
+    if not FLEET_SMOKE_GOLDEN.exists():
+        raise ValueError(
+            f"no fleet golden {FLEET_SMOKE_GOLDEN} "
+            f"(run --fleet-smoke --update)"
+        )
+    want = FLEET_SMOKE_GOLDEN.read_text()
+    if got != want:
+        raise ValueError(
+            "fleet smoke: fixed-seed report diverged from the "
+            "committed golden (byte comparison failed) — a timing-model "
+            "or fleet-report change must regen with "
+            "--fleet-smoke --update"
+        )
+
+    doc = res.doc
+    stats = res.stats.stats_dict()
+    for row in doc["curve"]:
+        lat = row["latency_ms"]
+        if lat is None or not all(
+            isinstance(lat.get(k), float) for k in ("p50", "p99")
+        ):
+            raise ValueError("fleet smoke: curve latency dist missing")
+        if row["served"] and row["energy_per_request_j"] is None:
+            raise ValueError(
+                "fleet smoke: energy per request missing on a serving "
+                "curve row"
+            )
+    if stats["fleet_lost_shed_total"] < 1:
+        raise ValueError(
+            "fleet smoke: no shedding losses (the overload load point "
+            "was chosen to produce them)"
+        )
+    if stats["fleet_pod_losses_total"] < 1 or not doc["recovery"]:
+        raise ValueError(
+            "fleet smoke: no pod loss / recovery row (the seed was "
+            "chosen to produce them)"
+        )
+    for rec in doc["recovery"]:
+        if rec["time_to_recover_s"] <= 0:
+            raise ValueError("fleet smoke: non-positive time-to-recover")
+    table = doc["frontier"]["table"]
+    if not table or table[0]["pods_needed"] is None:
+        raise ValueError("fleet smoke: capacity frontier answer is null")
+
+    errors = compare(run_matrix())
+    if errors:
+        raise ValueError(
+            "fleet smoke: healthy-path golden matrix diverged:\n  "
+            + "\n  ".join(errors)
+        )
+    return {
+        "requests": stats["fleet_requests_total"],
+        "served": stats["fleet_served_total"],
+        "shed": stats["fleet_lost_shed_total"],
+        "pod_losses": stats["fleet_pod_losses_total"],
+        "pods_needed": table[0]["pods_needed"],
+        "matrix_configs": len(MATRIX),
+    }
+
+
 #: the advise smoke: a fixed-spec strategy sweep on the llama_tiny
 #: fixture whose ranked report must be BYTE-identical to the committed
 #: golden.  The spec covers every synthesizable family (dp, tp, every
@@ -1666,7 +1794,32 @@ def main(argv: list[str] | None = None) -> int:
                          "committed golden (partition rate, inflation "
                          "percentiles, capacity table included) and "
                          "the healthy golden matrix must be untouched")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="run the fixed-seed fleet digital twin on the "
+                         "llama_tiny fixture: the report must be "
+                         "byte-identical to the committed golden "
+                         "(goodput/p99 curve, loss attribution with a "
+                         "live shedding window, a pod loss with its "
+                         "recovery row, a non-null capacity frontier) "
+                         "and the healthy golden matrix must be "
+                         "untouched")
     args = ap.parse_args(argv)
+
+    if args.fleet_smoke:
+        try:
+            summary = fleet_smoke(update=args.update)
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --fleet-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --fleet-smoke: OK "
+              f"({summary['served']:.0f}/{summary['requests']:.0f} "
+              f"requests served byte-identically to the committed "
+              f"report, {summary['shed']:.0f} shed, "
+              f"{summary['pod_losses']:.0f} pod loss(es) with recovery "
+              f"rows, frontier answer {summary['pods_needed']} pod(s), "
+              f"healthy matrix unchanged across "
+              f"{summary['matrix_configs']} configs)")
+        return 0
 
     if args.fastpath_parity:
         try:

@@ -83,15 +83,23 @@
 #                past its deadline 504s through cooperative in-process
 #                cancellation with the worker still alive (zero
 #                restarts/kills, warm caches serving the next request)
-#  16. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  16. fleet   — fleet digital-twin determinism (tpusim.fleet): a
+#                fixed-seed traffic-driven fleet simulation on the
+#                llama_tiny fixture must reproduce the committed
+#                report byte-for-byte (goodput/p99 curve, per-policy
+#                loss attribution with a live shedding window, a pod
+#                loss with its elastic-recovery row, a non-null
+#                capacity-frontier answer), with the healthy golden
+#                matrix untouched
+#  17. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-15
+# Usage:  bash ci/run_ci.sh            # tiers 1-16
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/16] build native from source (+ native parity suite) ==="
+echo "=== [1/17] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -105,53 +113,56 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/16] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/17] repo static analysis (ruff / stdlib fallback) ==="
 python ci/lint_repo.py
 
-echo "=== [3/16] unit tests (fast tier) ==="
+echo "=== [3/17] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/16] golden-stat regression sims ==="
+echo "=== [4/17] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/16] obs export smoke (schema-checked) ==="
+echo "=== [5/17] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/16] faults smoke (degraded-pod contract) ==="
+echo "=== [6/17] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/16] trace/config/schedule lint smoke ==="
+echo "=== [7/17] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/16] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/17] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/16] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
+echo "=== [9/17] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/16] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/17] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/16] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/17] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/16] front smoke (serve v3 multi-acceptor contract) ==="
+echo "=== [12/17] front smoke (serve v3 multi-acceptor contract) ==="
 python ci/check_golden.py --front-smoke
 
-echo "=== [13/16] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [13/17] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [14/16] advise smoke (sharding-advisor determinism) ==="
+echo "=== [14/17] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [15/16] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [15/17] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
+echo "=== [16/17] fleet smoke (digital-twin determinism) ==="
+python ci/check_golden.py --fleet-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [16/16] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [17/17] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [16/16] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [17/17] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

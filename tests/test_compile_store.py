@@ -426,3 +426,39 @@ def test_fastpath_namespace_licenses_serve():
     from tpusim.analysis.statskeys import STATS_NAMESPACES
 
     assert "tpusim/serve/" in STATS_NAMESPACES["fastpath_"]
+
+
+def test_enospc_disables_store_writes_with_one_warning(
+    tmp_path, monkeypatch,
+):
+    """ENOSPC/EIO graceful degradation on the compiled tier: a failed
+    staging write warns ONCE, disables further saves for the instance,
+    and pricing still serves the computed result."""
+    import errno
+
+    import tpusim.fastpath.store as S
+    from tpusim.fastpath.store import CompileStore, set_compile_store
+
+    def boom(tmp, payload, durable):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(S, "_stage_bytes", boom)
+    store = CompileStore(tmp_path)
+    set_compile_store(store)
+    dirs = _trace_dirs()[:2]
+    serial = [
+        _doc(_engine(backend="serial").run(_load_module(d)))
+        for d in dirs
+    ]
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        priced = [_doc(_engine().run(_load_module(d))) for d in dirs]
+    disabled = [
+        w for w in caught
+        if "disabling further store writes" in str(w.message)
+    ]
+    assert len(disabled) == 1            # two failed saves, one warning
+    assert store._write_disabled
+    assert store.stores == 0
+    assert priced == serial              # results served regardless
+    assert not list(tmp_path.glob("*.cmod"))

@@ -1,0 +1,516 @@
+"""tpusim.fleet — the traffic-driven fleet digital twin.
+
+Covers the subsystem's contracts: spec validation codes (TL24x),
+deterministic seeded arrivals and fault streams, the event walk's
+loss-attribution taxonomy (shed / deadline / partition / restart, each
+pinned by a hand-built scenario), same-seed byte-identical report
+documents, crash-safe resume (SIGKILL mid-run → ``--resume`` re-prices
+ZERO journaled pricing intervals and matches the uninterrupted report
+byte-for-byte), elastic-recovery rows, and the ``POST /v1/fleet`` serve
+path producing the CLI-identical document.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpusim.fleet import (
+    FleetSpecError,
+    load_fleet_spec,
+    run_fleet,
+    simulate_cell,
+)
+from tpusim.fleet.runner import PodState, build_intervals
+from tpusim.fleet.spec import Policies
+from tpusim.fleet.traffic import sample_arrivals, sample_pod_stream
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+TRACE = FIXTURES / "llama_tiny_tp2dp2"
+
+
+def base_spec(**over) -> dict:
+    doc = {
+        "name": "t-fleet", "seed": 3, "pods": 2,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "horizon_s": 30.0,
+        "traffic": {
+            "load_points": [6.0],
+            "mix": [{"name": "chat", "weight": 3.0, "steps": 50},
+                    {"name": "batch", "weight": 1.0, "steps": 200}],
+        },
+        "faults": {
+            "count": {"dist": "uniform", "min": 0, "max": 2},
+            "kinds": {"link_down": 1.0, "hbm_throttle": 1.0},
+            "scale": {"min": 0.4, "max": 0.9},
+            "window": {"min_s": 5.0, "max_s": 15.0},
+            "pod_loss": {"prob": 0.9},
+        },
+        "policies": {"max_inflight": 1, "queue_depth": 4,
+                     "deadline_s": 0.5, "restart_backoff_s": 3.0},
+    }
+    doc.update(over)
+    return doc
+
+
+# -- spec validation ---------------------------------------------------------
+
+
+def test_spec_parses_and_defaults():
+    spec = load_fleet_spec(base_spec())
+    assert spec.pods == 2
+    assert spec.horizon_s == 30.0
+    assert spec.policies.queue_depth == 4
+    assert spec.traffic.mix[1].steps == 200
+    assert spec.faults.pod_loss_prob == 0.9
+    # bare defaults compose too
+    spec2 = load_fleet_spec({"seed": 1})
+    assert spec2.pods == 1
+    assert spec2.traffic.shape == "poisson"
+
+
+@pytest.mark.parametrize("mutate, code", [
+    ({"pods": 0}, "TL240"),
+    ({"policies": {"deadline_s": 0.0}}, "TL240"),
+    ({"policies": {"warp_core": 1}}, "TL240"),
+    ({"faults": {"kinds": ["gamma_burst"]}}, "TL240"),
+    ({"faults": {"pod_loss": {"prob": 2.0}}}, "TL240"),
+    ({"recovery": {"dcn_gbps": 0}}, "TL240"),
+    ({"traffic": {"shape": "tidal"}}, "TL241"),
+    ({"traffic": {"load_points": []}}, "TL241"),
+    ({"traffic": {"load_points": [1e9]}, "horizon_s": 3600.0}, "TL241"),
+    ({"traffic": {"mix": [{"name": "a", "weight": 0}]}}, "TL241"),
+    ({"traffic": {"burst": {"factor": 20.0, "fraction": 0.5}}},
+     "TL241"),
+    ({"slo": {"latency_ms": 100.0, "percentile": 250}}, "TL242"),
+    ({"frontier": {"target_rps": [10.0], "max_pods": 4}}, "TL242"),
+])
+def test_spec_rejections_carry_codes(mutate, code):
+    doc = base_spec(**mutate)
+    if code == "TL242" and "slo" not in mutate:
+        doc.pop("slo", None)
+    with pytest.raises(FleetSpecError) as ei:
+        load_fleet_spec(doc)
+    assert ei.value.code == code
+
+
+def test_analyzer_maps_group_against_torus():
+    from tpusim.analysis import analyze_fleet_spec
+
+    diags = analyze_fleet_spec(
+        base_spec(correlated_groups=[
+            {"name": "ghost", "prob": 0.5, "axis": 7},
+        ]),
+        default_chips=8,
+    )
+    assert "TL243" in diags.codes()
+
+
+# -- seeded inputs -----------------------------------------------------------
+
+
+def test_arrivals_deterministic_and_rate_keyed():
+    spec = load_fleet_spec(base_spec())
+    a1 = sample_arrivals(spec.traffic, spec.seed, 6.0, 30.0)
+    a2 = sample_arrivals(spec.traffic, spec.seed, 6.0, 30.0)
+    assert a1 == a2
+    assert a1 != sample_arrivals(spec.traffic, spec.seed, 7.0, 30.0)
+    # open-loop Poisson at 6 req/s over 30s: a seeded draw near 180
+    assert 120 <= len(a1) <= 260
+    assert all(0.0 <= t < 30.0 for t, _ in a1)
+    assert all(cls in (0, 1) for _, cls in a1)
+
+
+def test_bursty_arrivals_preserve_mean_rate():
+    spec = load_fleet_spec(base_spec(traffic={
+        "shape": "bursty", "load_points": [20.0],
+        "burst": {"factor": 4.0, "fraction": 0.1, "period_s": 10.0},
+    }))
+    arr = sample_arrivals(spec.traffic, spec.seed, 20.0, 30.0)
+    assert 400 <= len(arr) <= 800        # mean 600, loose seeded bounds
+
+
+def test_pod_streams_are_per_pod_substreams():
+    from tpusim.ici.topology import torus_for
+
+    spec = load_fleet_spec(base_spec())
+    topo = torus_for(8, "v5p")
+    s0 = sample_pod_stream(spec, topo, 0)
+    s1 = sample_pod_stream(spec, topo, 1)
+    assert s0 == sample_pod_stream(spec, topo, 0)   # deterministic
+    assert s0 != s1                                  # independent
+    tl = build_intervals(s0, spec.horizon_s)
+    assert tl[0][0] == 0.0 and tl[-1][1] == spec.horizon_s
+    # contiguous cover
+    for (a, b, _s, _d), (c, _e, _s2, _d2) in zip(tl, tl[1:]):
+        assert b == c
+
+
+# -- the event walk's attribution taxonomy ----------------------------------
+
+
+def _row(step_s=0.1, energy=2.0, partitioned=False):
+    return {"partitioned": partitioned, "step_s": step_s,
+            "energy_j": energy, "inflation": 1.0}
+
+
+def _pod(intervals=None, deaths=(), horizon=100.0):
+    if intervals is None:
+        intervals = [(0.0, horizon, _row())]
+    return PodState(intervals=intervals, deaths=list(deaths))
+
+
+_POL = Policies(max_inflight=1, queue_depth=8, deadline_s=100.0,
+                restart_backoff_s=3.0)
+
+
+def test_partition_window_requests_land_in_partition_bucket():
+    """Requests dispatched into a partition window are partition
+    losses — not shed, not deadline — and service resumes after."""
+    pod = _pod(intervals=[
+        (0.0, 50.0, _row()),
+        (50.0, 80.0, _row(partitioned=True)),
+        (80.0, 100.0, _row()),
+    ])
+    cell = simulate_cell(
+        [(10.0, 0), (55.0, 0), (60.0, 0), (90.0, 0)],
+        [pod], _POL, 100.0, healthy_step_s=0.1, mix_steps=[1],
+    )
+    assert cell["losses"] == {"deadline": 0, "partition": 2,
+                              "restart": 0, "shed": 0}
+    assert cell["served"] == 2
+
+
+def test_queue_full_sheds():
+    pol = Policies(max_inflight=1, queue_depth=1, deadline_s=100.0,
+                   restart_backoff_s=3.0)
+    pod = _pod(intervals=[(0.0, 100.0, _row(step_s=10.0))])
+    cell = simulate_cell(
+        [(0.0, 0), (1.0, 0), (2.0, 0), (3.0, 0)],
+        [pod], pol, 100.0, healthy_step_s=10.0, mix_steps=[1],
+    )
+    # t=0 starts, t=1 queues (depth 1 full), t=2 and t=3 shed
+    assert cell["losses"]["shed"] == 2
+    assert cell["served"] == 2
+
+
+def test_deadline_cooperative_cancel_frees_server_at_budget():
+    pol = Policies(max_inflight=1, queue_depth=8, deadline_s=5.0,
+                   restart_backoff_s=3.0)
+    pod = _pod(intervals=[(0.0, 100.0, _row(step_s=10.0))])
+    cell = simulate_cell(
+        [(0.0, 0), (6.0, 0)],
+        [pod], pol, 100.0, healthy_step_s=10.0, mix_steps=[1],
+    )
+    # both requests outlive the budget: cancelled at t+5, the server
+    # freed at the deadline instant (t=6 starts at 6, not at 10)
+    assert cell["losses"]["deadline"] == 2
+    assert cell["served"] == 0
+
+
+def test_pod_crash_kills_inflight_and_redistributes():
+    pod0 = _pod(intervals=[(0.0, 100.0, _row(step_s=10.0))],
+                deaths=[(5.0, 8.0)])
+    pod1 = _pod()
+    # rr dispatch: t=0 -> pod0 (in flight at the crash -> restart),
+    # t=6 -> pod1 (pod0 down, next alive pod takes it), t=9 -> pod0
+    cell = simulate_cell(
+        [(0.0, 0), (6.0, 0), (9.0, 0)],
+        [pod0, pod1], _POL, 100.0, healthy_step_s=0.1, mix_steps=[1],
+    )
+    assert cell["losses"]["restart"] == 1
+    assert cell["served"] == 2
+
+
+def test_crash_beats_queued_deadline_attribution():
+    """A request queued past its deadline while the pod CRASHES first
+    is a restart loss, not a deadline loss — the crash killed the wait
+    line before the 504 would have fired."""
+    pol = Policies(max_inflight=1, queue_depth=8, deadline_s=1.0,
+                   restart_backoff_s=20.0)
+    pod = _pod(intervals=[(0.0, 100.0, _row(step_s=0.5))],
+               deaths=[(0.8, 20.8)])
+    # A serves (done before the crash); B and C are in flight/queued
+    # across it; D's virtual start (1.5) is past its deadline, but the
+    # crash at 0.8 got the line first
+    cell = simulate_cell(
+        [(0.0, 0), (0.01, 0), (0.05, 0), (0.1, 0)],
+        [pod], pol, 100.0, healthy_step_s=0.5, mix_steps=[1],
+    )
+    assert cell["served"] == 1
+    assert cell["losses"] == {"deadline": 0, "partition": 0,
+                              "restart": 3, "shed": 0}
+
+
+def test_all_pods_down_is_a_restart_loss():
+    pod = _pod(deaths=[(5.0, 8.0)])
+    cell = simulate_cell(
+        [(6.0, 0)], [pod], _POL, 100.0,
+        healthy_step_s=0.1, mix_steps=[1],
+    )
+    assert cell["losses"]["restart"] == 1
+    assert cell["served"] == 0
+
+
+def test_energy_and_mfu_accounting():
+    pod = _pod(intervals=[(0.0, 100.0, _row(step_s=2.0, energy=3.0))])
+    cell = simulate_cell(
+        [(0.0, 0), (10.0, 1)], [pod], _POL, 100.0,
+        healthy_step_s=2.0, mix_steps=[1, 2],
+    )
+    assert cell["served"] == 2
+    assert cell["energy_j"] == pytest.approx(3.0 * 1 + 3.0 * 2)
+    # 3 healthy-equivalent steps x 2s over 100 server-seconds
+    assert cell["mfu"] == pytest.approx(6.0 / 100.0)
+
+
+# -- end-to-end determinism --------------------------------------------------
+
+
+def test_same_seed_reproduces_report_byte_for_byte():
+    a = run_fleet(base_spec(), trace_path=TRACE)
+    b = run_fleet(base_spec(), trace_path=TRACE)
+    assert json.dumps(a.doc, sort_keys=True) == \
+        json.dumps(b.doc, sort_keys=True)
+    assert a.stats.stats_dict() == b.stats.stats_dict()
+    # a different seed is a different fleet
+    c = run_fleet(base_spec(seed=4), trace_path=TRACE)
+    assert json.dumps(c.doc, sort_keys=True) != \
+        json.dumps(a.doc, sort_keys=True)
+
+
+def test_axis_group_partitions_and_attributes():
+    """A correlated axis outage (prob 1) splits the 2x2x2 torus: the
+    state prices as partitioned and the window's requests land in the
+    partition bucket of the curve."""
+    spec = base_spec(
+        # axis 0 splits the fixture's 4 REPLAYING chips across the cut
+        # (axis 2 would only separate the replay set from idle chips)
+        correlated_groups=[{"name": "axis-x", "prob": 1.0, "axis": 0}],
+        faults={
+            "count": {"dist": "fixed", "n": 0},
+            "window": {"min_s": 10.0, "max_s": 20.0},
+            "pod_loss": {"prob": 0.0},
+        },
+        traffic={"load_points": [8.0],
+                 "mix": [{"name": "chat", "weight": 1.0, "steps": 20}]},
+    )
+    res = run_fleet(spec, trace_path=TRACE)
+    assert res.stats.states_partitioned >= 1
+    row = res.doc["curve"][0]
+    assert row["losses"]["partition"] > 0
+    assert row["requests"] == row["served"] + sum(
+        row["losses"].values()
+    )
+
+
+def test_recovery_rows_price_rerank_and_migration():
+    res = run_fleet(base_spec(), trace_path=TRACE)
+    assert res.stats.pod_losses >= 1
+    assert res.doc["recovery"], "seeded pod losses produced no rows"
+    for r in res.doc["recovery"]:
+        assert r["time_to_recover_s"] >= r["restart_s"]
+        assert r["migration_s"] > 0
+        if r["survivors"] >= 1:
+            labels = {c["candidate"] for c in r["rerank"]}
+            assert "keep" in labels
+            assert r["chosen"] in labels
+            for c in r["rerank"]:
+                assert c["step_ms"] > 0
+                assert c["fleet_rps"] > 0
+            # the choice maximizes effective fleet throughput —
+            # requests-worth of the original load served per second
+            best = max(c["fleet_rps"] for c in r["rerank"])
+            chosen_row = next(
+                c for c in r["rerank"] if c["candidate"] == r["chosen"]
+            )
+            assert chosen_row["fleet_rps"] == best
+
+
+def test_frontier_answers_pods_needed():
+    spec = base_spec(
+        slo={"latency_ms": 400.0, "percentile": 95},
+        frontier={"target_rps": [10.0], "max_pods": 4},
+    )
+    res = run_fleet(spec, trace_path=TRACE)
+    table = res.doc["frontier"]["table"]
+    assert len(table) == 1
+    need = table[0]["pods_needed"]
+    assert need is not None and 1 <= need <= 4
+    # the ladder stops at the first meeting size
+    assert table[0]["cells"][-1]["slo"]["meets"]
+    assert all(not c["slo"]["meets"] for c in table[0]["cells"][:-1])
+
+
+def test_fleet_keys_only_when_fleet_ran():
+    """The campaign_* discipline: a healthy simulate run stamps no
+    fleet_* keys (the namespace is registered and owned)."""
+    from tpusim.analysis.statskeys import STATS_NAMESPACES
+    from tpusim.sim.driver import simulate_trace
+
+    assert "fleet_" in STATS_NAMESPACES
+    assert "tpusim/fleet/" in STATS_NAMESPACES["fleet_"]
+    report = simulate_trace(str(TRACE), arch="v5p", tuned=False)
+    stats = json.loads(report.stats.to_json())
+    assert not [k for k in stats if k.startswith("fleet_")]
+
+
+# -- crash-safe resume -------------------------------------------------------
+
+KILL_SCRIPT = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from tpusim.fleet import run_fleet
+
+done = 0
+def progress(msg):
+    global done
+    done += 1
+    if done == {kill_after}:
+        os.kill(os.getpid(), signal.SIGKILL)   # no cleanup, no atexit
+
+run_fleet(
+    {spec!r}, trace_path={trace!r}, out_dir={out!r}, progress=progress,
+)
+"""
+
+
+def test_resume_after_sigkill_reprices_zero_journaled(tmp_path):
+    """SIGKILL mid-run; --resume completes while re-pricing ONLY the
+    states the journal does not already hold, and the stitched report
+    is byte-identical to an uninterrupted run."""
+    from tpusim.campaign.journal import Journal
+
+    spec = base_spec(seed=3)      # seed 3 prices 3 distinct states
+    out = tmp_path / "fleet"
+    kill_after = 2
+    script = KILL_SCRIPT.format(
+        repo=str(REPO), spec=spec, trace=str(TRACE), out=str(out),
+        kill_after=kill_after,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    pre = [
+        r for r in Journal(out).read_records()
+        if r.get("kind") in ("state", "recovery")
+    ]
+    # the journal is a true prefix: exactly the rows whose progress
+    # message fired before the kill (states price before recoveries)
+    assert len(pre) == kill_after
+    assert all(r["kind"] == "state" for r in pre)
+
+    import tpusim.fleet.runner as runner_mod
+
+    priced = {"n": 0}
+    orig = runner_mod._price
+
+    def counting(*args, **kw):
+        priced["n"] += 1
+        return orig(*args, **kw)
+
+    runner_mod._price = counting
+    try:
+        res = run_fleet(spec, trace_path=TRACE, out_dir=out, resume=True)
+    finally:
+        runner_mod._price = orig
+
+    clean = run_fleet(spec, trace_path=TRACE)
+    total_states = clean.stats.states_priced
+    assert total_states > kill_after   # the kill landed mid-pricing
+    assert res.stats.states_resumed == kill_after
+    assert res.stats.states_priced == total_states - kill_after
+    assert priced["n"] == total_states - kill_after
+    assert json.dumps(res.doc, sort_keys=True) == \
+        json.dumps(clean.doc, sort_keys=True)
+
+
+def test_full_journal_resume_prices_nothing(tmp_path):
+    """Resume over a COMPLETE journal re-prices zero intervals: no
+    state replays, no recovery replays, no engine walks at all."""
+    import tpusim.sim.driver as driver_mod
+
+    spec = base_spec()
+    out = tmp_path / "fleet"
+    first = run_fleet(spec, trace_path=TRACE, out_dir=out)
+
+    runs = {"n": 0}
+    orig_run = driver_mod.SimDriver.run
+
+    def counting_run(self, pod):
+        runs["n"] += 1
+        return orig_run(self, pod)
+
+    driver_mod.SimDriver.run = counting_run
+    try:
+        res = run_fleet(spec, trace_path=TRACE, out_dir=out, resume=True)
+    finally:
+        driver_mod.SimDriver.run = orig_run
+
+    assert runs["n"] == 0
+    assert res.stats.states_priced == 0
+    assert json.dumps(res.doc, sort_keys=True) == \
+        json.dumps(first.doc, sort_keys=True)
+    assert (out / "report.json").is_file()
+
+
+def test_fresh_journal_refuses_to_clobber(tmp_path):
+    from tpusim.fleet import JournalError
+
+    spec = base_spec()
+    run_fleet(spec, trace_path=TRACE, out_dir=tmp_path)
+    with pytest.raises(JournalError, match="resume"):
+        run_fleet(spec, trace_path=TRACE, out_dir=tmp_path)
+
+
+def test_resume_refuses_a_different_fleet(tmp_path):
+    from tpusim.fleet import JournalError
+
+    run_fleet(base_spec(), trace_path=TRACE, out_dir=tmp_path)
+    with pytest.raises(JournalError, match="refusing"):
+        run_fleet(base_spec(seed=99), trace_path=TRACE,
+                  out_dir=tmp_path, resume=True)
+
+
+# -- serve path --------------------------------------------------------------
+
+
+def test_served_fleet_doc_is_byte_identical_to_direct(tmp_path):
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    spec = base_spec()
+    direct = run_fleet(spec, trace_path=TRACE)
+    with ServeDaemon(trace_root=FIXTURES, port=0) as d:
+        c = ServeClient(d.url)
+        job_id = c.fleet(spec=spec, trace=TRACE.name)
+        status = c.wait_job(job_id, timeout_s=300)
+        assert status.status == "done", status.error
+        assert json.dumps(status.result, sort_keys=True) == \
+            json.dumps(direct.doc, sort_keys=True)
+        # the executor totals ride /metrics under the serve_fleet_* name
+        metrics = c.metrics_text()
+        assert "serve_fleet_requests_total" in metrics
+
+
+def test_bad_fleet_spec_fails_job_with_code(tmp_path):
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    with ServeDaemon(trace_root=FIXTURES, port=0) as d:
+        c = ServeClient(d.url)
+        job_id = c.fleet(spec={"pods": 0}, trace=TRACE.name)
+        status = c.wait_job(job_id, timeout_s=60)
+        assert status.status == "failed"
+        assert "bad_fleet_spec" in (status.error or "")

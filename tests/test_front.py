@@ -473,3 +473,41 @@ def test_client_never_replays_job_submission_on_reset(resetting_server):
         c.sweep(arch="v5p", chips=8)
     assert ei.value.code == "connection_failed"
     assert len(seen) == 1  # one attempt, no replay
+
+
+def test_hotcache_enospc_disables_publishes_with_one_warning(
+    tmp_path, monkeypatch,
+):
+    """ENOSPC/EIO graceful degradation on the hot tier: a failed
+    segment append warns ONCE, disables further publishes for the
+    instance, and requests keep flowing through the ordinary path."""
+    import errno
+    import warnings as _warnings
+
+    import tpusim.serve.hotcache as H
+
+    c = HotResponseCache(tmp_path, generation="g")
+    assert c.publish("k0", b"before")   # the healthy path works
+
+    def boom(seg_path, body):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(H, "_append_segment", boom)
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        assert c.publish("k1", b"body1") is False
+        assert c.publish("k2", b"body2") is False   # no re-warn
+    disabled = [
+        w for w in caught
+        if "disabling further hot publishes" in str(w.message)
+    ]
+    assert len(disabled) == 1
+    assert c._publish_disabled
+    # reads keep serving what the index already names
+    assert bytes(c.get("k0")) == b"before"
+    assert c.get("k1") is None
+    # a fresh instance (healthy medium again) publishes normally
+    monkeypatch.undo()
+    fresh = HotResponseCache(tmp_path, generation="g")
+    assert fresh.publish("k1", b"body1")
+    assert bytes(fresh.get("k1")) == b"body1"

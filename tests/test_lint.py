@@ -114,6 +114,14 @@ def _advise_defect(doc: dict):
     return build
 
 
+def _fleet_defect(doc: dict):
+    def build(tmp_path: Path) -> Diagnostics:
+        from tpusim.analysis import analyze_fleet_spec
+
+        return analyze_fleet_spec(doc, default_chips=8)
+    return build
+
+
 def _statskey_defect(files: dict[str, str], schema: dict | None = None):
     """Seed a miniature repo with the audited layout and run the
     stats-key contract pass against it."""
@@ -340,6 +348,24 @@ ENTRY %main (p0: f32[8]) -> f32[8] {
     ("advise-slo-without-candidates", {"TL224"}, _advise_defect(
         {"strategies": ["dp"], "slices": [],
          "slo": {"step_time_ms": 1.0}},
+    )),
+    ("fleet-bad-policy", {"TL240"}, _fleet_defect(
+        {"seed": 1, "pods": 2,
+         "policies": {"deadline_s": 0.0}},
+    )),
+    ("fleet-bad-load-point", {"TL241"}, _fleet_defect(
+        {"seed": 1, "pods": 2, "horizon_s": 3600.0,
+         "traffic": {"load_points": [1e9]}},
+    )),
+    ("fleet-frontier-without-slo", {"TL242"}, _fleet_defect(
+        {"seed": 1, "pods": 2,
+         "frontier": {"target_rps": [10.0], "max_pods": 4}},
+    )),
+    ("fleet-absent-group-axis", {"TL243"}, _fleet_defect(
+        {"seed": 1, "pods": 2, "arch": "v5p", "chips": 8,
+         "correlated_groups": [
+             {"name": "ghost-axis", "prob": 0.5, "axis": 7},
+         ]},
     )),
     ("statskey-ownership", {"TL301"}, _statskey_defect({
         "tpusim/timing/engine.py":

@@ -592,3 +592,78 @@ def test_durable_disk_cache_fsyncs_before_replace(tmp_path, monkeypatch):
     r2 = CachedEngine(cfg, result_cache=c2).run(mod)
     assert c2.disk_hits == 1
     assert r2.cycles == r1.cycles
+
+
+def test_enospc_disables_disk_writes_with_one_warning(tmp_path, monkeypatch):
+    """ENOSPC/EIO graceful degradation: a staging write failing with a
+    medium-level errno warns ONCE, disables further disk writes for the
+    instance, and every request still serves from the computed result —
+    never a crash, never a warning per request."""
+    import errno
+    import warnings as _warnings
+
+    import tpusim.perf.cache as C
+
+    def boom(tmp, text, durable):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(C, "_stage_write", boom)
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache(disk_dir=tmp_path / "store")
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        r1 = CachedEngine(cfg, result_cache=cache).run(mod)  # put fails
+        r2 = CachedEngine(cfg, result_cache=cache).run(mod)  # mem hit
+        # a second DISTINCT put must not re-warn (writes are disabled)
+        cfg2 = overlay(cfg, {"arch": {"hbm_efficiency": 0.5}})
+        r3 = CachedEngine(cfg2, result_cache=cache).run(mod)
+    disabled = [
+        w for w in caught if "disabling further" in str(w.message)
+    ]
+    assert len(disabled) == 1
+    assert r2 is r1                      # the result still serves
+    assert r3.cycles != r1.cycles        # and fresh work still prices
+    assert cache._disk_write_disabled
+    assert cache.disk_errors == 1
+    assert not list((tmp_path / "store").glob("*.json"))
+    # the drain-time flush is a no-op, not a warning storm
+    with _warnings.catch_warnings(record=True) as caught2:
+        _warnings.simplefilter("always")
+        assert cache.flush() == 0
+    assert not caught2
+
+
+def test_transient_oserror_keeps_disk_writes_enabled(tmp_path, monkeypatch):
+    """A non-medium OSError (EACCES and friends) keeps the pre-existing
+    warn-and-continue behavior — only full/failing media disable."""
+    import errno
+    import warnings as _warnings
+
+    import tpusim.perf.cache as C
+
+    calls = {"n": 0}
+    real = C._stage_write
+
+    def flaky(tmp, text, durable):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(errno.EACCES, "Permission denied")
+        return real(tmp, text, durable)
+
+    monkeypatch.setattr(C, "_stage_write", flaky)
+    pod = load_trace(FIXTURES / "matmul_512")
+    mod = next(iter(pod.modules.values()))
+    cfg = load_config(arch="v5e", tuned=False)
+    cache = ResultCache(disk_dir=tmp_path / "store")
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        CachedEngine(cfg, result_cache=cache).run(mod)
+    assert [w for w in caught if "continuing uncached" in str(w.message)]
+    assert not cache._disk_write_disabled
+    # the next put succeeds and publishes
+    cfg2 = overlay(cfg, {"arch": {"hbm_efficiency": 0.5}})
+    CachedEngine(cfg2, result_cache=cache).run(mod)
+    assert list((tmp_path / "store").glob("*.json"))

@@ -779,3 +779,188 @@ def test_client_per_call_timeout_override(black_hole):
     with pytest.raises(ServeError):
         c.healthz(timeout_s=0.25)
     assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Retry-After honoring (opt-in) + the preserved never-replay rules
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def backpressure_server():
+    """A stub daemon that 503s with Retry-After N times, then 202s —
+    the restarting-primary / overloaded-queue stand-in.  Yields
+    ``((host, port), hits, set_refusals)``."""
+    import http.server
+
+    hits: list[str] = []
+    state = {"refusals": 1, "retry_after": "0.3"}
+
+    class _H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            self.rfile.read(length)
+            hits.append(self.path)
+            if len(hits) <= state["refusals"]:
+                body = json.dumps({"error": "overloaded",
+                                   "detail": "queue full"}).encode()
+                self.send_response(503)
+                self.send_header("Retry-After", state["retry_after"])
+            else:
+                body = json.dumps({"job_id": "job-000001",
+                                   "status": "queued"}).encode()
+                self.send_response(202)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv.server_address, hits, state
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_honors_retry_after_on_503(backpressure_server):
+    """With honor_retry_after=True the client sleeps at least the
+    server's hint and re-issues — safe even for a job submission,
+    because a clean 503 means the server refused (nothing to replay)."""
+    (host, port), hits, _state = backpressure_server
+    c = ServeClient(
+        f"http://{host}:{port}", retries=2, honor_retry_after=True,
+        backoff_base_s=0.01,
+    )
+    t0 = time.monotonic()
+    job_id = c.sweep(arch="v5p", chips=8)
+    elapsed = time.monotonic() - t0
+    assert job_id == "job-000001"
+    assert len(hits) == 2              # one refusal + one success
+    assert elapsed >= 0.3              # the hint was honored
+    assert elapsed < 10.0              # and capped/jittered sanely
+
+
+def test_client_retry_after_is_opt_in(backpressure_server):
+    """The default client surfaces the 503 immediately (PR 5/9/11
+    behavior unchanged) — honoring is opt-in."""
+    (host, port), hits, _state = backpressure_server
+    c = ServeClient(f"http://{host}:{port}", retries=2)
+    with pytest.raises(ServeError) as ei:
+        c.sweep(arch="v5p", chips=8)
+    assert ei.value.status == 503
+    assert ei.value.retry_after_s == pytest.approx(0.3)
+    assert len(hits) == 1
+
+
+def test_client_retry_after_budget_exhausts(backpressure_server):
+    """Refusals past the retries budget surface the last 503 — the
+    client never spins forever on a saturated server."""
+    (host, port), hits, state = backpressure_server
+    state["refusals"] = 5
+    state["retry_after"] = "0.01"
+    c = ServeClient(
+        f"http://{host}:{port}", retries=2, honor_retry_after=True,
+        backoff_base_s=0.001,
+    )
+    with pytest.raises(ServeError) as ei:
+        c.sweep(arch="v5p", chips=8)
+    assert ei.value.status == 503
+    assert len(hits) == 3              # initial + retries budget of 2
+
+
+def test_honoring_client_still_never_replays_sent_post(black_hole):
+    """honor_retry_after must not weaken the transport-level rule: a
+    POST whose bytes finished sending and then TIMED OUT is never
+    replayed — the server may still be executing it."""
+    (host, port), accepted = black_hole
+    c = ServeClient(
+        f"http://{host}:{port}", timeout_s=0.3, retries=3,
+        backoff_base_s=0.01, honor_retry_after=True,
+    )
+    with pytest.raises(ServeError) as ei:
+        c.sweep(arch="v5p", chips=8)
+    assert ei.value.code == "timeout"
+    assert len(accepted) == 1          # one attempt, no replay
+
+
+# ---------------------------------------------------------------------------
+# JobTable boot robustness: torn persist files quarantine, never abort
+# ---------------------------------------------------------------------------
+
+
+def test_jobtable_recovery_quarantines_torn_persist_file(tmp_path):
+    """A truncated per-job JSON file (daemon killed mid-persist before
+    the atomic replace, or disk damage) quarantines with ONE warning;
+    the healthy jobs recover intact."""
+    import warnings as _warnings
+
+    from tpusim.serve.admission import JobTable
+
+    jobs_dir = tmp_path / "jobs"
+    jobs_dir.mkdir()
+    healthy = {
+        "job-000001": {"job_id": "job-000001", "kind": "sweep",
+                       "request": {"arch": "v5p"}, "status": "queued"},
+        "job-000003": {"job_id": "job-000003", "kind": "campaign",
+                       "request": {"spec": {}}, "status": "done",
+                       "result": {"ok": True}},
+    }
+    for jid, doc in healthy.items():
+        (jobs_dir / f"{jid}.json").write_text(json.dumps(doc))
+    # the torn file: a prefix of valid JSON (no closing brace)
+    (jobs_dir / "job-000002.json").write_text(
+        '{"job_id": "job-000002", "kind": "sweep", "requ'
+    )
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        table = JobTable(persist_dir=jobs_dir)
+    warned = [w for w in caught if "job-000002" in str(w.message)]
+    assert len(warned) == 1
+    assert "quarantined" in str(warned[0].message)
+
+    # the healthy jobs are all present, the torn one is gone
+    assert table.get("job-000001").status == "queued"
+    assert table.get("job-000003").status == "done"
+    assert table.get("job-000002") is None
+    assert table.recovered == 1        # the queued job re-enqueued
+    assert (jobs_dir / "quarantine" / "job-000002.json").is_file()
+    assert not (jobs_dir / "job-000002.json").exists()
+    # id allocation continues past every healthy id
+    job = table.submit("sweep", {"arch": "v5p"})
+    assert job.job_id == "job-000004"
+
+    # a SECOND boot over the same dir re-warns nothing (the damage
+    # was moved aside, not left to re-trip every startup)
+    with _warnings.catch_warnings(record=True) as caught2:
+        _warnings.simplefilter("always")
+        table2 = JobTable(persist_dir=jobs_dir)
+    assert not [w for w in caught2 if "unreadable" in str(w.message)]
+    assert table2.get("job-000001") is not None
+
+
+def test_jobtable_recovery_quarantines_wrong_shape(tmp_path):
+    """A file that parses as JSON but is not a job object (a list, a
+    doc missing its request) also quarantines instead of silently
+    lingering forever."""
+    import warnings as _warnings
+
+    from tpusim.serve.admission import JobTable
+
+    jobs_dir = tmp_path / "jobs"
+    jobs_dir.mkdir()
+    (jobs_dir / "job-000001.json").write_text(json.dumps(["not", "a", "job"]))
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        table = JobTable(persist_dir=jobs_dir)
+    assert len([w for w in caught if "job-000001" in str(w.message)]) == 1
+    assert table.get("job-000001") is None
+    assert (jobs_dir / "quarantine" / "job-000001.json").is_file()

@@ -594,6 +594,107 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_fleet(args: argparse.Namespace) -> int:
+    """Traffic-driven fleet digital twin: a seeded discrete-event
+    simulation of N serving pods under an open-loop arrival process
+    with a campaign-style fault stream, governed by the serve daemon's
+    admission policies — goodput/MFU/p99-vs-load curves, a pods-needed
+    capacity frontier, energy per served request, and per-policy loss
+    attribution.  Crash-safe: re-run with --resume to continue with
+    zero journaled pricing intervals re-priced."""
+    from tpusim.analysis import ValidationError
+    from tpusim.fleet import FleetSpecError, JournalError, run_fleet
+    from tpusim.guard.cancel import CancelToken, OperationCancelled
+
+    progress = None
+    if args.verbose:
+        def progress(msg: str) -> None:
+            print(f"  {msg}", file=sys.stderr)
+    cancel = None
+    if getattr(args, "max_wall_s", None):
+        cancel = CancelToken.after(args.max_wall_s)
+    try:
+        res = run_fleet(
+            args.spec,
+            trace_path=args.trace,
+            out_dir=args.out,
+            resume=args.resume,
+            result_cache=args.result_cache,
+            workers=args.workers,
+            progress=progress,
+            cancel=cancel,
+            compile_cache=args.compile_cache,
+        )
+    except OperationCancelled as e:
+        hint = (
+            f"re-run with --resume --out {args.out} to continue from "
+            f"the last journaled pricing interval" if args.out
+            else "pass --out DIR to make cancelled fleet runs resumable"
+        )
+        print(f"tpusim fleet: cancelled: {e}; {hint}", file=sys.stderr)
+        return 3
+    except FleetSpecError as e:
+        print(f"tpusim fleet: spec refused ({e.code}): {e}",
+              file=sys.stderr)
+        return 1
+    except ValidationError as e:
+        print(f"tpusim fleet: spec refused:\n{e}", file=sys.stderr)
+        return 1
+    except JournalError as e:
+        print(f"tpusim fleet: {e}", file=sys.stderr)
+        return 1
+    doc = res.doc
+    s = res.stats
+    print(f"tpusim fleet: {doc['fleet']!r} seed={doc['seed']} "
+          f"spec={doc['spec_hash']} trace={doc['trace']}")
+    print(f"  {doc['pods']} pod(s) x {doc['chips']} {doc['arch']} "
+          f"chips over {doc['horizon_s']:g}s; healthy step "
+          f"{doc['healthy']['step_ms']:.3f}ms "
+          f"({s.states_priced} state(s) priced, {s.states_resumed} "
+          f"resumed, {s.pod_losses} pod loss(es); "
+          f"{res.wall_seconds:.2f}s)")
+    for r in doc["curve"]:
+        lat = r["latency_ms"]
+        line = (f"  {r['offered_rps']:8.1f} req/s -> "
+                f"{r['goodput_rps']:8.1f} goodput, "
+                f"mfu {r['mfu']:.3f}")
+        if lat is not None:
+            line += (f", p50 {lat['p50']:.1f}ms p99 {lat['p99']:.1f}ms")
+        losses = r["losses"]
+        line += (f"; lost: {losses['shed']} shed, "
+                 f"{losses['deadline']} deadline, "
+                 f"{losses['partition']} partition, "
+                 f"{losses['restart']} restart")
+        if r.get("slo") is not None:
+            line += f" -> {'MEETS' if r['slo']['meets'] else 'MISSES'}"
+        print(line)
+    frontier = doc.get("frontier")
+    if frontier is not None:
+        for row in frontier["table"]:
+            need = row["pods_needed"]
+            shown = (str(need) if need is not None
+                     else f"MORE THAN {frontier['max_pods']}")
+            print(f"  frontier: {row['target_rps']:g} req/s at "
+                  f"p{frontier['percentile']:g} <= "
+                  f"{frontier['slo_latency_ms']:g}ms needs "
+                  f"{shown} pod(s)")
+    for r in doc["recovery"]:
+        print(f"  recovery: pod {r['pod']} lost at {r['at_s']:.1f}s, "
+              f"{r['survivors']} survivor(s), re-shard "
+              f"{r['chosen'] or 'none'}, recover in "
+              f"{r['time_to_recover_s']:.1f}s")
+    for k, v in s.stats_dict().items():
+        print(f"  {k} = {v:.0f}")
+    if res.report_path is not None:
+        print(f"  report written to {res.report_path}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"  report also written to {args.json}")
+    return 0
+
+
 def _cmd_advise(args: argparse.Namespace) -> int:
     """Parallelism-strategy sweep & sharding advisor: price the
     slices x strategies x meshes cross-product of one traced workload
@@ -1530,6 +1631,52 @@ def main(argv: list[str] | None = None) -> int:
                      help="per-scenario progress on stderr")
     pcm.set_defaults(fn=_cmd_campaign)
 
+    pfl = sub.add_parser(
+        "fleet",
+        help="traffic-driven fleet digital twin: N simulated serving "
+             "pods under an open-loop arrival process with a seeded "
+             "fault stream and the serve daemon's admission policies "
+             "-> goodput/MFU/p99-vs-load curves, a pods-needed "
+             "capacity frontier, energy per request, and per-policy "
+             "loss attribution",
+    )
+    pfl.add_argument("spec", help="fleet spec JSON (see "
+                                  "docs/ARCHITECTURE.md)")
+    pfl.add_argument("--trace", required=True,
+                     help="trace directory the fleet serves")
+    pfl.add_argument("--out", default=None, metavar="DIR",
+                     help="fleet state dir: crash-safe journal.jsonl "
+                          "+ report.json (required for --resume)")
+    pfl.add_argument("--resume", action="store_true",
+                     help="continue a killed fleet run from its "
+                          "journal in --out (journaled pricing "
+                          "intervals are never re-priced)")
+    pfl.add_argument("--workers", type=int, default=None, metavar="N",
+                     help="fan each replay's module pricing over N "
+                          "processes (states price serially so the "
+                          "journal stays a true prefix)")
+    pfl.add_argument("--result-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="share the engine-result cache on disk "
+                          "(in-memory sharing across states is "
+                          "always on; this persists it across runs)")
+    pfl.add_argument("--compile-cache", nargs="?", const=True,
+                     default=None, metavar="DIR",
+                     help="durable compiled-module tier: a fresh "
+                          "fleet run over an already-compiled trace "
+                          "parses and compiles nothing "
+                          "(tpusim.fastpath.store)")
+    pfl.add_argument("--max-wall-s", type=float, default=None, metavar="S",
+                     help="cooperative wall-clock budget: the run "
+                          "cancels at the next pricing/cell boundary "
+                          "with everything priced so far journaled — "
+                          "--resume re-prices nothing (exit 3)")
+    pfl.add_argument("--json", default=None,
+                     help="also write the report document here")
+    pfl.add_argument("--verbose", action="store_true",
+                     help="per-state/per-cell progress on stderr")
+    pfl.set_defaults(fn=_cmd_fleet)
+
     pad = sub.add_parser(
         "advise",
         help="parallelism-strategy sweep & sharding advisor: price the "
@@ -1567,8 +1714,9 @@ def main(argv: list[str] | None = None) -> int:
     psv = sub.add_parser(
         "serve",
         help="simulation-as-a-service daemon: JSON API (simulate/lint/"
-             "sweep/campaign/jobs/healthz/metrics) with hot traces, "
-             "admission control, shared result cache, SIGTERM drain",
+             "sweep/campaign/advise/fleet/jobs/healthz/metrics) with "
+             "hot traces, admission control, shared result cache, "
+             "SIGTERM drain",
     )
     psv.add_argument("--host", default="127.0.0.1")
     psv.add_argument("--port", type=int, default=8642,

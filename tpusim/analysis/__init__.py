@@ -19,6 +19,7 @@ from tpusim.analysis.diagnostics import (
 )
 from tpusim.analysis.advise_passes import analyze_advise_spec
 from tpusim.analysis.campaign_passes import analyze_campaign_spec
+from tpusim.analysis.fleet_passes import analyze_fleet_spec
 from tpusim.analysis.runner import (
     ValidationError,
     analyze_config,
@@ -39,6 +40,7 @@ __all__ = [
     "analyze_advise_spec",
     "analyze_campaign_spec",
     "analyze_config",
+    "analyze_fleet_spec",
     "analyze_schedule",
     "analyze_stats_keys",
     "analyze_trace_dir",

@@ -124,6 +124,16 @@ _code("TL222", _E, "pinned mesh shape does not factor any candidate "
 _code("TL223", _E, "advise candidate slice names an arch with no preset")
 _code("TL224", _E, "advise SLO given without candidate slices to rank")
 
+# --- fleet passes (TL24x) --------------------------------------------------
+_code("TL240", _E, "fleet spec fails format validation (bad field, "
+                   "policy, or fault model)")
+_code("TL241", _E, "fleet traffic model invalid (shape, mix, or a load "
+                   "point past the per-cell arrival ceiling)")
+_code("TL242", _E, "fleet SLO/frontier invalid (percentile range, "
+                   "frontier without an SLO)")
+_code("TL243", _E, "fleet correlated group references links or axes "
+                   "absent from the pod torus")
+
 # --- stats-key contract (TL3xx) --------------------------------------------
 _code("TL301", _E, "stats key written outside its namespace's owning "
                    "subsystem")

@@ -108,6 +108,16 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/guard/", "tpusim/perf/", "tpusim/sim/driver.py",
         "tpusim/serve/", "tpusim/__main__.py", "ci/check_golden.py",
     ),
+    # the fleet digital twin (tpusim.fleet): traffic-driven serving-
+    # simulation accounting (requests served, per-policy loss
+    # attribution, priced degradation states, pod losses) — stamped
+    # only when a fleet twin actually ran (the campaign_* discipline:
+    # healthy simulate reports never carry them); tpusim.serve mirrors
+    # the totals on /metrics for async fleet jobs
+    "fleet_": (
+        "tpusim/fleet/", "tpusim/serve/", "tpusim/__main__.py",
+        "ci/check_golden.py",
+    ),
     # the sharding advisor (PR 7): strategy-sweep executor accounting
     # (cells priced/skipped/feasible) — stamped only when an advise
     # sweep actually ran (the faults_* discipline: healthy simulate
@@ -171,6 +181,7 @@ AUDIT_GLOBS = (
     "tpusim/serve/*.py",
     "tpusim/campaign/*.py",
     "tpusim/advise/*.py",
+    "tpusim/fleet/*.py",
     "tpusim/guard/*.py",
     "tpusim/timing/engine.py",
 )

@@ -74,6 +74,16 @@ def _np():
     return numpy
 
 
+def _stage_bytes(tmp: Path, payload: bytes, durable: bool) -> None:
+    """Stage one record's bytes to its temp file (the injection seam
+    the ENOSPC regression tests monkeypatch)."""
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
+
+
 #: the f64 pricing columns of one CompiledComputation, in a fixed order
 #: (the record format's column table)
 _COLUMN_ATTRS = (
@@ -250,6 +260,10 @@ class CompileStore:
         self.stores = 0
         self.errors = 0
         self.quarantined = 0
+        # ENOSPC/EIO graceful degradation: a medium-level staging
+        # failure disables this instance's write path (one warning
+        # ever); loads keep serving the records that made it
+        self._write_disabled = False
 
     def model_version(self) -> str:
         # composite timing+parser stamp, same derivation as the result
@@ -401,6 +415,8 @@ class CompileStore:
     def save(self, cm, key: str) -> bool:
         """Serialize every compiled computation of ``cm`` and publish
         the record atomically.  Returns False on (warned) failure."""
+        if self._write_disabled:
+            return False
         try:
             payload = self._serialize(cm, key)
         except (ValueError, TypeError) as e:  # pragma: no cover - defensive
@@ -428,11 +444,7 @@ class CompileStore:
                     old_size = path.stat().st_size
                 except OSError:
                     old_size = 0
-            with open(tmp, "wb") as f:
-                f.write(payload)
-                if self.durable:
-                    f.flush()
-                    os.fsync(f.fileno())
+            _stage_bytes(tmp, payload, self.durable)
             os.replace(tmp, path)
             if self.durable:
                 dir_fd = os.open(self.disk_dir, os.O_RDONLY)
@@ -443,16 +455,26 @@ class CompileStore:
         except OSError as e:
             with self._lock:
                 self.errors += 1
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            from tpusim.perf.cache import fatal_write_disable
+
+            if fatal_write_disable(
+                e,
+                f"tpusim.fastpath: compiled-module write failed "
+                f"under {self.disk_dir} ({e}); disabling further "
+                f"store writes for this instance (loads continue)",
+            ):
+                self._write_disabled = True
+                return False
             warnings.warn(
                 f"tpusim.fastpath: compiled-module write failed under "
                 f"{self.disk_dir} ({e}); continuing undurable",
                 RuntimeWarning,
                 stacklevel=2,
             )
-            try:
-                tmp.unlink()
-            except OSError:
-                pass
             return False
         with self._lock:
             self.stores += 1

@@ -39,6 +39,7 @@ state (obs samplers, recorded timelines) are never cached;
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import json
 import os
@@ -129,6 +130,38 @@ def parser_version() -> str:
 
 def _sha(text: str) -> str:
     return hashlib.sha256(text.encode()).hexdigest()[:24]
+
+
+#: OSError errnos that mean the durable tier's medium is gone (full,
+#: failing, or read-only) — one more write will not fare better, so the
+#: store disables its write path for the instance's lifetime instead of
+#: warning on every request (ENOSPC/EIO graceful degradation; shared by
+#: the compile store and the hot-response cache)
+FATAL_WRITE_ERRNOS = frozenset({
+    errno.ENOSPC, errno.EDQUOT, errno.EIO, errno.EROFS,
+})
+
+
+def fatal_write_disable(exc: OSError, message: str) -> bool:
+    """The shared disable decision of the three durable tiers: when
+    ``exc`` is a medium-level failure, emit the single disable warning
+    (``message``, each tier's own wording) and return True — the caller
+    sets its instance flag and stops writing.  Non-fatal errnos return
+    False and the caller keeps its pre-existing behavior."""
+    if exc.errno not in FATAL_WRITE_ERRNOS:
+        return False
+    warnings.warn(message, RuntimeWarning, stacklevel=3)
+    return True
+
+
+def _stage_write(tmp: Path, text: str, durable: bool) -> None:
+    """Stage one record's bytes to its temp file (the injection seam
+    the ENOSPC regression tests monkeypatch)."""
+    with open(tmp, "w") as f:
+        f.write(text)
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
 
 
 def module_fingerprint(module) -> str | None:
@@ -353,6 +386,11 @@ class ResultCache:
         self.evictions = 0
         self.disk_hits = 0
         self.disk_errors = 0
+        # ENOSPC/EIO graceful degradation: once a staging write fails
+        # with a medium-level errno, this instance stops writing (one
+        # warning ever) and keeps serving from memory + existing disk
+        # records — a full disk must never crash or spam a serving run
+        self._disk_write_disabled = False
         # tpusim.guard accounting
         self.quarantined = 0
         self.gc_runs = 0
@@ -517,6 +555,8 @@ class ResultCache:
                 return None
 
     def _disk_put(self, key: str, result: EngineResult) -> None:
+        if self._disk_write_disabled:
+            return
         with self.obs.span("cache"):
             try:
                 self.disk_dir.mkdir(parents=True, exist_ok=True)
@@ -533,11 +573,7 @@ class ResultCache:
                 tmp = path.with_suffix(
                     f".{os.getpid()}.{threading.get_ident()}.tmp"
                 )
-                with open(tmp, "w") as f:
-                    f.write(json.dumps(doc))
-                    if self.durable:
-                        f.flush()
-                        os.fsync(f.fileno())
+                _stage_write(tmp, json.dumps(doc), self.durable)
                 governed = (
                     self.quota_bytes is not None
                     or self.quota_entries is not None
@@ -566,6 +602,19 @@ class ResultCache:
             except OSError as e:
                 self.disk_errors += 1
                 self.obs.counter_add("cache.disk_errors")
+                try:
+                    tmp.unlink()
+                except (OSError, NameError):
+                    pass
+                if fatal_write_disable(
+                    e,
+                    f"tpusim.perf: result-cache write failed under "
+                    f"{self.disk_dir} ({e}); disabling further "
+                    f"disk writes for this cache instance "
+                    f"(reads and in-memory caching continue)",
+                ):
+                    self._disk_write_disabled = True
+                    return
                 warnings.warn(
                     f"tpusim.perf: result-cache write failed under "
                     f"{self.disk_dir} ({e}); continuing uncached",
@@ -680,12 +729,14 @@ class ResultCache:
         full, permission blip) — the serving daemon calls it on SIGTERM
         drain so a restart warms from everything the process computed.
         Returns the number of records written."""
-        if self.disk_dir is None:
+        if self.disk_dir is None or self._disk_write_disabled:
             return 0
         with self._lock:
             items = list(self._mem.items())
         healed = 0
         for key, result in items:
+            if self._disk_write_disabled:
+                break
             if not self._path_for(key).is_file():
                 self._disk_put(key, result)
                 healed += 1

@@ -322,26 +322,44 @@ class JobTable:
         except OSError:
             pass
 
+    def _quarantine(self, path, reason: str) -> None:
+        """Move one torn/corrupt persist file aside (a single warning;
+        the file keeps its name under ``quarantine/`` for forensics)
+        so every later boot recovers cleanly instead of re-warning —
+        or worse, aborting — on the same damage."""
+        import warnings
+
+        target = self.persist_dir / "quarantine" / path.name
+        try:
+            os.makedirs(target.parent, exist_ok=True)
+            os.replace(path, target)
+            moved = f"quarantined to {target.parent.name}/{target.name}"
+        except OSError:
+            moved = "left in place (quarantine move failed)"
+        warnings.warn(
+            f"job table: persisted job {path.name} is unreadable "
+            f"({reason}); {moved}, recovery continues with the "
+            f"healthy jobs",
+            RuntimeWarning, stacklevel=3,
+        )
+
     def _recover(self) -> None:
         """Reload persisted jobs: terminal ones return to the polling
         table, queued/RUNNING ones re-enqueue (a job that was mid-run
         when the daemon died must run again — resumable kinds pick up
-        from their own journal)."""
-        import warnings
-
-        recs = []
-        for path in sorted(self.persist_dir.glob("job-*.json")):
-            try:
-                recs.append(json.loads(path.read_text()))
-            except (OSError, json.JSONDecodeError) as e:
-                warnings.warn(
-                    f"job table: dropping unreadable persisted job "
-                    f"{path.name}: {e}",
-                    RuntimeWarning, stacklevel=2,
-                )
+        from their own journal).  A torn or corrupt per-job file —
+        a daemon killed mid-``_persist`` before the atomic replace, or
+        disk damage — quarantines with ONE warning and recovery
+        continues: one bad file must never take down the healthy
+        jobs' crash-safety."""
         from tpusim.guard.cancel import CancelToken
 
-        for doc in recs:
+        for path in sorted(self.persist_dir.glob("job-*.json")):
+            try:
+                doc = json.loads(path.read_text())
+            except (OSError, json.JSONDecodeError, ValueError) as e:
+                self._quarantine(path, f"{type(e).__name__}: {e}")
+                continue
             try:
                 job = Job(
                     job_id=str(doc["job_id"]),
@@ -353,7 +371,9 @@ class JobTable:
                     cancel_token=CancelToken(),
                 )
                 num = int(job.job_id.rsplit("-", 1)[1])
-            except (KeyError, TypeError, ValueError, IndexError):
+            except (KeyError, TypeError, ValueError, IndexError,
+                    AttributeError) as e:
+                self._quarantine(path, f"{type(e).__name__}: {e}")
                 continue
             self._next_id = max(self._next_id, num)
             self._jobs[job.job_id] = job

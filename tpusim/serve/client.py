@@ -129,6 +129,8 @@ class ServeClient:
         retries: int = 1,
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
+        honor_retry_after: bool = False,
+        retry_after_max_s: float = 30.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
@@ -137,6 +139,16 @@ class ServeClient:
         self.retries = max(int(retries), 0)
         self.backoff_base_s = max(float(backoff_base_s), 0.0)
         self.backoff_max_s = max(float(backoff_max_s), self.backoff_base_s)
+        #: opt-in: honor ``Retry-After`` on 429/503 RESPONSES by
+        #: sleeping and re-issuing, up to the same ``retries`` budget.
+        #: Safe even for job submissions — a clean 429/503 means the
+        #: server REFUSED the request, so re-sending is not a replay
+        #: (unlike a transport failure after the bytes left, which
+        #: stays never-replayed); timeouts are likewise never retried.
+        self.honor_retry_after = bool(honor_retry_after)
+        #: ceiling on one honored wait — a server advertising a
+        #: pathological hint must not park the client for minutes
+        self.retry_after_max_s = max(float(retry_after_max_s), 0.0)
         parsed = urllib.parse.urlsplit(self.base_url)
         if parsed.scheme != "http" or parsed.hostname is None:
             raise ValueError(
@@ -258,27 +270,70 @@ class ServeClient:
                 time.sleep(self._backoff_s(attempt, path))
                 attempt += 1
 
+    def _retry_after_wait_s(self, retry_after_s: float, attempt: int,
+                            path: str) -> float:
+        """One honored backpressure wait: the server's hint floored by
+        the client's own exponential schedule (so repeated refusals
+        still back off even under a constant hint), jittered
+        deterministically (±25%, the ``_backoff_s`` salt — N clients
+        refused together fan back out de-synchronized), capped at
+        ``retry_after_max_s``."""
+        base = max(float(retry_after_s), self._backoff_s(attempt, path))
+        h = hashlib.sha256(
+            f"{self._jitter_salt}:ra:{path}:{attempt}".encode()
+        ).digest()
+        jitter = 0.25 * int.from_bytes(h[:4], "big") / 0xFFFFFFFF
+        return min(base * (1.0 + jitter), self.retry_after_max_s)
+
     def _request(
         self, method: str, path: str, body: dict | None = None,
         timeout_s: float | None = None, idempotent: bool = False,
     ) -> dict:
-        resp, payload = self._raw(
-            method, path, body, timeout_s=timeout_s, idempotent=idempotent,
-        )
-        try:
-            doc = json.loads(payload or b"{}")
-        except (json.JSONDecodeError, ValueError):
-            doc = {}
-        if resp.status >= 400:
+        attempt = 0
+        while True:
+            resp, payload = self._raw(
+                method, path, body, timeout_s=timeout_s,
+                idempotent=idempotent,
+            )
+            try:
+                doc = json.loads(payload or b"{}")
+            except (json.JSONDecodeError, ValueError):
+                doc = {}
+            if resp.status < 400:
+                return doc
             retry_after = resp.getheader("Retry-After")
+            if (
+                self.honor_retry_after
+                and resp.status in (429, 503)
+                and retry_after is not None
+                and attempt < self.retries
+            ):
+                # a clean backpressure refusal: the server did NOT
+                # execute the request, so re-issuing is safe for every
+                # route — job submissions included (the never-replay
+                # rule guards ambiguous TRANSPORT failures, which
+                # _raw still never replays once the bytes left)
+                try:
+                    hint = float(retry_after)
+                except (TypeError, ValueError):
+                    hint = 1.0
+                time.sleep(self._retry_after_wait_s(hint, attempt, path))
+                attempt += 1
+                continue
+            try:
+                # Retry-After may legally be an HTTP-date; surface
+                # an unparseable hint as None, never a raw ValueError
+                retry_after_s = float(retry_after) \
+                    if retry_after is not None else None
+            except (TypeError, ValueError):
+                retry_after_s = None
             raise ServeError(
                 resp.status,
                 str(doc.get("error", "http_error")),
                 str(doc.get("detail", resp.reason)),
                 doc=doc,
-                retry_after_s=float(retry_after) if retry_after else None,
+                retry_after_s=retry_after_s,
             )
-        return doc
 
     # -- routes --------------------------------------------------------------
 
@@ -386,6 +441,16 @@ class ServeClient:
         document."""
         doc = self._request(
             "POST", "/v1/campaign", request, timeout_s=timeout_s,
+        )
+        return str(doc["job_id"])
+
+    def fleet(self, timeout_s: float | None = None, **request) -> str:
+        """Submit an async fleet digital-twin run (``spec=`` + the
+        usual ``trace=``/``hlo_text=``); returns the job id.  Poll
+        with :meth:`wait_job` — the result is the fleet capacity
+        report document."""
+        doc = self._request(
+            "POST", "/v1/fleet", request, timeout_s=timeout_s,
         )
         return str(doc["job_id"])
 

@@ -17,6 +17,10 @@ route                 verb  backing layer
                             progress journaled, restart resumes)
 ``/v1/advise``        POST  :class:`JobTable` (async; the sharding
                             advisor's ranked strategy-sweep report)
+``/v1/fleet``         POST  :class:`JobTable` (async; the fleet
+                            digital twin's capacity report — crash-
+                            safe like campaign jobs: spec persisted,
+                            pricing journaled, restart resumes)
 ``/v1/jobs/<id>``     GET   :class:`JobTable`
 ``/v1/jobs/<id>``     DEL   :class:`JobTable` (cooperative cancel —
                             queued jobs land ``cancelled`` at once,
@@ -316,7 +320,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/v1/lint":
             d._count("serve_requests_lint_total")
             self._run_sync("lint", d.worker.lint)
-        elif path in ("/v1/sweep", "/v1/campaign", "/v1/advise"):
+        elif path in ("/v1/sweep", "/v1/campaign", "/v1/advise",
+                      "/v1/fleet"):
             kind = path.rsplit("/", 1)[1]
             if d.is_primary:
                 # secondaries skip the per-kind counter: the primary
@@ -1325,17 +1330,29 @@ class ServeDaemon:
             return None
         return self.state_dir / "campaigns" / job_id
 
-    def _evict_job_state(self, job_id: str) -> None:
-        d = self.campaign_dir(job_id)
-        if d is not None and d.is_dir():
-            import shutil
+    def fleet_dir(self, job_id: str):
+        """Where one fleet job journals — the campaign discipline
+        under its own subtree."""
+        if self.state_dir is None:
+            return None
+        return self.state_dir / "fleet" / job_id
 
-            shutil.rmtree(d, ignore_errors=True)
+    def _evict_job_state(self, job_id: str) -> None:
+        import shutil
+
+        for d in (self.campaign_dir(job_id), self.fleet_dir(job_id)):
+            if d is not None and d.is_dir():
+                shutil.rmtree(d, ignore_errors=True)
 
     def _run_job(self, job) -> dict:
         if job.kind == "campaign":
             return self.worker.campaign(
                 job.request, out_dir=self.campaign_dir(job.job_id),
+                cancel=job.cancel_token,
+            )
+        if job.kind == "fleet":
+            return self.worker.fleet(
+                job.request, out_dir=self.fleet_dir(job.job_id),
                 cancel=job.cancel_token,
             )
         if job.kind == "advise":

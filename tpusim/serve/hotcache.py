@@ -59,6 +59,17 @@ DEFAULT_QUOTA_BYTES = 64 * 1024 * 1024
 MAX_ENTRY_FRACTION = 8
 
 
+def _append_segment(seg_path: Path, body: bytes) -> int:
+    """Append one body to the segment, fsync'd; returns its offset
+    (the injection seam the ENOSPC regression tests monkeypatch)."""
+    with open(seg_path, "ab") as seg:
+        offset = seg.tell()
+        seg.write(body)
+        seg.flush()
+        os.fsync(seg.fileno())
+    return offset
+
+
 def hot_generation(model_version: str, format_version: int) -> str:
     """The cache generation fingerprint: everything that could change
     what a cached response body MEANS without changing the request
@@ -110,6 +121,11 @@ class HotResponseCache:
         self.misses = 0
         self.publishes = 0
         self.rotations = 0
+        # ENOSPC/EIO graceful degradation: a medium-level failure on
+        # the publish path disables further publishes for this
+        # instance (one warning ever); the read path keeps serving
+        # whatever the index already names
+        self._publish_disabled = False
         self._reap_other_generations()
 
     # -- maintenance ---------------------------------------------------------
@@ -140,47 +156,60 @@ class HotResponseCache:
         bodies by the serving contract).  Returns True when this call
         made the entry visible."""
         body = bytes(body)
+        if self._publish_disabled:
+            return False
         if len(body) > self.quota_bytes // MAX_ENTRY_FRACTION:
             return False
-        with open(self._lock_path, "a+b") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            doc = self._read_index_doc()
-            entries = doc.get("entries", {})
-            if key in entries:
-                return False
-            segment = doc.get("segment") or f"seg-{self.generation}.dat"
-            seg_path = self.dir / segment
-            size = seg_path.stat().st_size if seg_path.exists() else 0
-            if size + len(body) > self.quota_bytes:
-                # epoch flush: a fresh segment + empty index.  Readers
-                # follow the index's segment name; the orphaned file is
-                # unlinked (their open mmaps stay valid until replaced)
-                self.rotations += 1
-                epoch = int(doc.get("epoch", 0)) + 1
-                try:
-                    seg_path.unlink()
-                except OSError:
-                    pass
-                segment = f"seg-{self.generation}-{epoch}.dat"
+        try:
+            with open(self._lock_path, "a+b") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                doc = self._read_index_doc()
+                entries = doc.get("entries", {})
+                if key in entries:
+                    return False
+                segment = doc.get("segment") \
+                    or f"seg-{self.generation}.dat"
                 seg_path = self.dir / segment
-                entries = {}
-                doc["epoch"] = epoch
-                size = 0
-            with open(seg_path, "ab") as seg:
-                offset = seg.tell()
-                seg.write(body)
-                seg.flush()
-                os.fsync(seg.fileno())
-            entries[key] = [offset, len(body)]
-            doc.update({
-                "format": 1,
-                "generation": self.generation,
-                "segment": segment,
-                "entries": entries,
-            })
-            tmp = self._idx_path.with_suffix(f".tmp.{os.getpid()}")
-            tmp.write_text(json.dumps(doc, sort_keys=True))
-            os.replace(tmp, self._idx_path)
+                size = seg_path.stat().st_size if seg_path.exists() else 0
+                if size + len(body) > self.quota_bytes:
+                    # epoch flush: a fresh segment + empty index.
+                    # Readers follow the index's segment name; the
+                    # orphaned file is unlinked (their open mmaps stay
+                    # valid until replaced)
+                    self.rotations += 1
+                    epoch = int(doc.get("epoch", 0)) + 1
+                    try:
+                        seg_path.unlink()
+                    except OSError:
+                        pass
+                    segment = f"seg-{self.generation}-{epoch}.dat"
+                    seg_path = self.dir / segment
+                    entries = {}
+                    doc["epoch"] = epoch
+                    size = 0
+                offset = _append_segment(seg_path, body)
+                entries[key] = [offset, len(body)]
+                doc.update({
+                    "format": 1,
+                    "generation": self.generation,
+                    "segment": segment,
+                    "entries": entries,
+                })
+                tmp = self._idx_path.with_suffix(f".tmp.{os.getpid()}")
+                tmp.write_text(json.dumps(doc, sort_keys=True))
+                os.replace(tmp, self._idx_path)
+        except OSError as e:
+            from tpusim.perf.cache import fatal_write_disable
+
+            if fatal_write_disable(
+                e,
+                f"tpusim.serve: hot-response publish failed under "
+                f"{self.dir} ({e}); disabling further hot "
+                f"publishes for this instance (reads continue)",
+            ):
+                self._publish_disabled = True
+                return False
+            raise  # transient: the daemon counts it and carries on
         self.publishes += 1
         return True
 

@@ -410,6 +410,56 @@ class ServeWorker:
         self._accumulate(result.stats.stats_dict())
         return result.doc
 
+    def fleet(self, req: dict, out_dir=None, cancel=None) -> dict:
+        """``POST /v1/fleet`` body → the fleet digital-twin report
+        (runs on a job thread).  ``req['spec']`` is the fleet spec
+        document; the workload is the usual ``trace``/``hlo_text``
+        pair.  With a daemon ``--state-dir``, ``out_dir`` points at
+        this job's journal directory — a restarted daemon re-enters
+        here and resumes with zero journaled pricing intervals
+        re-priced."""
+        import json as _json
+
+        from tpusim.analysis import ValidationError
+        from tpusim.fleet import FleetSpecError, load_fleet_spec, run_fleet
+
+        spec_doc = req.get("spec")
+        if not isinstance(spec_doc, dict):
+            raise RequestError(
+                400, "bad_request",
+                "'spec' (a fleet spec object) is required",
+            )
+        try:
+            spec = load_fleet_spec(spec_doc)
+        except FleetSpecError as e:
+            raise RequestError(
+                400, "bad_fleet_spec", str(e),
+                extra={"codes": [e.code]},
+            )
+        entry, _inline = self._resolve_entry(req)
+        try:
+            result = run_fleet(
+                spec,
+                pod=entry.pod,
+                trace_name=entry.name,
+                out_dir=out_dir,
+                resume=out_dir is not None,
+                result_cache=self.result_cache,
+                workers=self.workers,
+                cancel=cancel,
+            )
+        except ValidationError as e:
+            raise RequestError(
+                400, "validation_failed",
+                f"fleet spec refused: {e.diags.summary()}",
+                extra={
+                    "codes": sorted(d.code for d in e.diags.errors),
+                    "diagnostics": _json.loads(e.diags.to_json()),
+                },
+            )
+        self._accumulate(result.stats.stats_dict())
+        return result.doc
+
     def advise(self, req: dict, cancel=None) -> dict:
         """``POST /v1/advise`` body → the ranked advisor report (runs
         on a job thread).  ``req['spec']`` is the advise spec document;
