@@ -308,12 +308,159 @@ def lint_smoke() -> dict:
     _require_clean(analyze_stats_keys(), "stats-key audit")
 
     lines = list_code_lines()
-    if len(lines) != len(CODES):
+    code_lines = [ln for ln in lines if ln.startswith("TL")]
+    if len(code_lines) != len(CODES):
         raise ValueError(
-            f"lint smoke: --list-codes prints {len(lines)} lines but "
-            f"the registry has {len(CODES)} codes"
+            f"lint smoke: --list-codes prints {len(code_lines)} code "
+            f"lines but the registry has {len(CODES)} codes"
+        )
+    if not any(ln.startswith("[") for ln in lines):
+        raise ValueError(
+            "lint smoke: --list-codes lost its family grouping headers"
         )
     return {"artifacts": checked, "codes": len(CODES)}
+
+
+def dataflow_smoke() -> dict:
+    """Dataflow / deadlock / self-audit contract smoke (`tpusim.analysis`
+    v2):
+
+    1. every committed fixture trace + golden-matrix arch lints with
+       ZERO TL4xx (memory) and TL41x (collective-matching) errors —
+       the new semantic passes must not refuse a healthy workload;
+    2. the liveness pass AGREES with the engine: per-module static
+       vmem residency and peak-live bytes equal the engine's own
+       capacity-model walk on the full fixture + silicon corpus;
+    3. a seeded two-device mismatched-collective trace is REFUSED:
+       ``tpusim lint`` reports a TL41x error and ``simulate
+       --validate`` raises instead of pricing a trace that can never
+       complete;
+    4. the TL35x determinism/durability self-audit over the repo's own
+       sources is green.
+    Raises on violation."""
+    import tempfile
+
+    from tpusim.analysis import analyze_self_audit, analyze_trace_dir
+    from tpusim.analysis.dataflow import analyze_module
+    from tpusim.timing.engine import (
+        _vmem_peak_live_bytes, _vmem_resident_bytes,
+    )
+    from tpusim.trace.format import load_trace
+
+    fixtures = sorted({m[0] for m in MATRIX})
+    arches = sorted({m[1] for m in MATRIX})
+    new_families = ("TL4",)
+    checked = 0
+    for fixture in fixtures:
+        for arch in arches:
+            diags = analyze_trace_dir(
+                FIXTURES / fixture, arch=arch, tuned=False,
+            )
+            bad = [
+                d for d in diags.errors
+                if d.code.startswith(new_families)
+            ]
+            if bad:
+                raise ValueError(
+                    f"dataflow smoke: {fixture}@{arch} has TL4xx/TL41x "
+                    f"errors on a healthy trace:\n"
+                    + "\n".join(d.text() for d in bad)
+                )
+            checked += 1
+
+    # 2. liveness == engine on the corpus
+    corpus = [FIXTURES / f for f in fixtures]
+    silicon = REPO / "reports" / "silicon"
+    if silicon.is_dir():
+        corpus += sorted(
+            d for d in silicon.iterdir() if (d / "modules").is_dir()
+        )
+    agreed = 0
+    for trace_dir in corpus:
+        pod = load_trace(trace_dir)
+        for name, module in pod.modules.items():
+            df = analyze_module(module)
+            want_resident = _vmem_resident_bytes(module)
+            want_peak = _vmem_peak_live_bytes(module)
+            if df.alloc_total("vmem") != want_resident or \
+                    df.peak_live("vmem") != want_peak:
+                raise ValueError(
+                    f"dataflow smoke: liveness disagrees with the "
+                    f"engine on {trace_dir.name}/{name}: "
+                    f"resident {df.alloc_total('vmem')} vs "
+                    f"{want_resident}, peak {df.peak_live('vmem')} "
+                    f"vs {want_peak}"
+                )
+            agreed += 1
+
+    # 3. the seeded two-device mismatched-collective trace is refused
+    hlo = (
+        "HloModule tiny, num_partitions=4\n\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n"
+        "}\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        trace = Path(td) / "deadlock"
+        (trace / "modules").mkdir(parents=True)
+        (trace / "modules" / "tiny.hlo").write_text(hlo)
+        (trace / "meta.json").write_text(json.dumps(
+            {"num_devices": 4, "device_kind": "cpu"}
+        ))
+        cmds = [
+            {"kind": "kernel_launch", "module": "tiny", "device": 0},
+            {"kind": "kernel_launch", "module": "tiny", "device": 1},
+            {"kind": "collective", "device": 0, "bytes": 1024,
+             "collective": {"kind": "all-reduce",
+                            "replica_groups": [[0, 1]]}},
+            {"kind": "collective", "device": 1, "bytes": 1024,
+             "collective": {"kind": "all-gather",
+                            "replica_groups": [[0, 1]]}},
+        ]
+        (trace / "commandlist.jsonl").write_text(
+            "\n".join(json.dumps(c) for c in cmds) + "\n"
+        )
+        diags = analyze_trace_dir(trace, arch="v5p", tuned=False)
+        deadlock = [
+            d for d in diags.errors if d.code.startswith("TL41")
+        ]
+        if not deadlock:
+            raise ValueError(
+                "dataflow smoke: the seeded mismatched-collective "
+                "trace was NOT flagged:\n"
+                + "\n".join(diags.text_lines())
+            )
+        from tpusim.analysis import ValidationError
+        from tpusim.sim.driver import simulate_trace
+
+        try:
+            simulate_trace(trace, arch="v5p", tuned=False,
+                           validate="on")
+        except ValidationError as e:
+            if "TL41" not in str(e):
+                raise ValueError(
+                    f"dataflow smoke: --validate refused for the "
+                    f"wrong reason: {e}"
+                )
+        else:
+            raise ValueError(
+                "dataflow smoke: simulate --validate priced the "
+                "deadlocked trace instead of refusing it"
+            )
+
+    # 4. the self-audit over the repo itself
+    audit = analyze_self_audit()
+    if audit.items:
+        raise ValueError(
+            "dataflow smoke: TL35x self-audit is not clean:\n"
+            + "\n".join(audit.text_lines())
+        )
+    return {
+        "lint_cells": checked,
+        "modules_agreed": agreed,
+        "deadlock_code": deadlock[0].code,
+    }
 
 
 #: stats the perf/guard layers add only when active — stripped before
@@ -1731,6 +1878,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="run tpusim lint over every checked-in golden "
                          "trace/config/fault-schedule and require zero "
                          "error-level diagnostics")
+    ap.add_argument("--dataflow-smoke", action="store_true",
+                    help="dataflow-analysis contract: committed "
+                         "fixtures lint clean of TL4xx/TL41x errors, "
+                         "the liveness pass agrees with the engine's "
+                         "residency walk on the fixture corpus, a "
+                         "seeded two-device mismatched-collective "
+                         "trace is refused, and the TL35x self-audit "
+                         "over tpusim/ is green")
     ap.add_argument("--perf-smoke", action="store_true",
                     help="replay the golden matrix with --workers 4 and "
                          "an on-disk result cache: must match the "
@@ -1804,6 +1959,20 @@ def main(argv: list[str] | None = None) -> int:
                          "and the healthy golden matrix must be "
                          "untouched")
     args = ap.parse_args(argv)
+
+    if args.dataflow_smoke:
+        try:
+            summary = dataflow_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --dataflow-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --dataflow-smoke: OK "
+              f"({summary['lint_cells']} fixture/arch cells clean of "
+              f"TL4xx/TL41x errors, liveness==engine on "
+              f"{summary['modules_agreed']} corpus modules, seeded "
+              f"deadlock refused with {summary['deadlock_code']}, "
+              f"TL35x self-audit green)")
+        return 0
 
     if args.fleet_smoke:
         try:

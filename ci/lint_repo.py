@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import json
 import re
 import shutil
 import subprocess
@@ -199,13 +200,18 @@ def check_file(
     return findings
 
 
-def run_fallback() -> int:
+def run_fallback(emit_json: bool = False) -> int:
     limit = _line_length_limit()
     ignores = _per_file_ignores()
     findings: list[str] = []
     files = python_files()
     for path in files:
         findings.extend(check_file(path, limit, ignores))
+    if emit_json:
+        print(json.dumps(_json_doc(
+            "stdlib-ast", findings, files=len(files),
+        ), indent=2))
+        return 1 if findings else 0
     for f in findings:
         print(f)
     status = "FAILED" if findings else "OK"
@@ -217,12 +223,51 @@ def run_fallback() -> int:
     return 1 if findings else 0
 
 
+def _json_doc(
+    engine: str, findings: list[str], files: int | None = None,
+) -> dict:
+    """The machine-readable report.  ``engine`` names which linter
+    actually ran — CI logs were ambiguous about ruff vs the stdlib
+    fallback until this field existed, and the two backends cover
+    different rule breadths."""
+    doc = {
+        "format_version": 1,
+        "engine": engine,
+        "count": len(findings),
+        "findings": findings,
+    }
+    if files is not None:
+        doc["files"] = files
+    return doc
+
+
+def run_ruff(ruff: str, emit_json: bool = False) -> int:
+    if not emit_json:
+        proc = subprocess.run([ruff, "check", "."], cwd=REPO)
+        status = "OK" if proc.returncode == 0 else "FAILED"
+        print(f"ci/lint_repo (ruff): {status}")
+        return proc.returncode
+    proc = subprocess.run(
+        [ruff, "check", ".", "--output-format", "concise"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    findings = [
+        line for line in proc.stdout.splitlines()
+        if line.strip() and not line.startswith(("Found ", "All checks"))
+    ]
+    print(json.dumps(_json_doc("ruff", findings), indent=2))
+    return proc.returncode
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--list", action="store_true",
                     help="print the backend that would run and exit")
     ap.add_argument("--fallback", action="store_true",
                     help="force the stdlib checker even if ruff exists")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout (engine, "
+                         "count, findings); exit code unchanged")
     args = ap.parse_args(argv)
 
     ruff = shutil.which("ruff")
@@ -231,13 +276,8 @@ def main(argv: list[str] | None = None) -> int:
                              "stdlib fallback"))
         return 0
     if ruff and not args.fallback:
-        proc = subprocess.run(
-            [ruff, "check", "."], cwd=REPO,
-        )
-        status = "OK" if proc.returncode == 0 else "FAILED"
-        print(f"ci/lint_repo (ruff): {status}")
-        return proc.returncode
-    return run_fallback()
+        return run_ruff(ruff, emit_json=args.json)
+    return run_fallback(emit_json=args.json)
 
 
 if __name__ == "__main__":

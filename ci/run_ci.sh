@@ -11,7 +11,9 @@
 #                — pricing then falls back to the vectorized/serial
 #                Python paths, which the later tiers still verify
 #   2. lint    — repo-wide static analysis (ruff when installed, the
-#                stdlib fallback in ci/lint_repo.py otherwise)
+#                stdlib fallback in ci/lint_repo.py otherwise); the
+#                JSON report's `engine` field is printed so the log
+#                names which linter actually ran
 #   3. unit    — pytest fast tier (the improvement over the reference's
 #                CI-only testing, SURVEY.md §4)
 #   4. golden  — simulate committed fixture traces across a config matrix,
@@ -91,15 +93,23 @@
 #                loss with its elastic-recovery row, a non-null
 #                capacity-frontier answer), with the healthy golden
 #                matrix untouched
-#  17. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  17. dataflow — tpusim.analysis v2 contract: committed fixtures +
+#                golden-matrix traces lint clean of TL4xx/TL41x
+#                errors, the liveness pass agrees byte-for-byte with
+#                the engine's residency walk across the fixture +
+#                silicon corpus, a seeded two-device
+#                mismatched-collective trace is statically refused,
+#                and the TL35x determinism/durability self-audit over
+#                tpusim/'s own sources is green
+#  18. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-16
+# Usage:  bash ci/run_ci.sh            # tiers 1-17
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/17] build native from source (+ native parity suite) ==="
+echo "=== [1/18] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -113,56 +123,68 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/17] repo static analysis (ruff / stdlib fallback) ==="
-python ci/lint_repo.py
+echo "=== [2/18] repo static analysis (ruff / stdlib fallback) ==="
+lint_rc=0
+python ci/lint_repo.py --json > /tmp/tpusim_lint_repo.json || lint_rc=$?
+python - <<'PYEOF'
+import json
+doc = json.load(open("/tmp/tpusim_lint_repo.json"))
+print(f"lint engine: {doc['engine']} — {doc['count']} finding(s)")
+for f in doc["findings"]:
+    print(f)
+PYEOF
+[[ "$lint_rc" == "0" ]] || exit "$lint_rc"
 
-echo "=== [3/17] unit tests (fast tier) ==="
+echo "=== [3/18] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/17] golden-stat regression sims ==="
+echo "=== [4/18] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/17] obs export smoke (schema-checked) ==="
+echo "=== [5/18] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/17] faults smoke (degraded-pod contract) ==="
+echo "=== [6/18] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/17] trace/config/schedule lint smoke ==="
+echo "=== [7/18] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/17] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/18] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/17] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
+echo "=== [9/18] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/17] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/18] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/17] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/18] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/17] front smoke (serve v3 multi-acceptor contract) ==="
+echo "=== [12/18] front smoke (serve v3 multi-acceptor contract) ==="
 python ci/check_golden.py --front-smoke
 
-echo "=== [13/17] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [13/18] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [14/17] advise smoke (sharding-advisor determinism) ==="
+echo "=== [14/18] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [15/17] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [15/18] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
-echo "=== [16/17] fleet smoke (digital-twin determinism) ==="
+echo "=== [16/18] fleet smoke (digital-twin determinism) ==="
 python ci/check_golden.py --fleet-smoke
 
+echo "=== [17/18] dataflow smoke (liveness/deadlock/self-audit contract) ==="
+python ci/check_golden.py --dataflow-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [17/17] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [18/18] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [17/17] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [18/18] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -1,0 +1,470 @@
+"""tpusim.analysis.dataflow — the whole-trace dataflow engine.
+
+Pins the three contracts the semantic passes stand on:
+
+1. **engine agreement** — static per-space liveness (vmem residency
+   sum + peak-live bytes) equals the engine's own capacity-model walk
+   byte-for-byte across the committed fixture + silicon corpus;
+2. **def-use / schedule chains** — undefined and misordered operands
+   surface exactly once each, and liveness intervals cover def→last-use
+   with alias extension;
+3. **collective matching** — the TL41x matcher aligns staggered
+   disjoint groups without false positives and reports a genuine
+   stall deterministically;
+
+plus the streaming-lint discipline: analysis through the deferred
+per-computation walk is byte-identical to the eager walk, and lint on
+a streaming-scale module holds the bounded-RSS contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpusim.analysis.dataflow import analyze_module
+from tpusim.trace.hlo_text import parse_hlo_module
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "traces"
+SILICON = REPO / "reports" / "silicon"
+
+
+def _corpus_dirs() -> list[Path]:
+    dirs = [FIXTURES / "llama_tiny_tp2dp2", FIXTURES / "matmul_512"]
+    if SILICON.is_dir():
+        dirs += sorted(
+            d for d in SILICON.iterdir() if (d / "modules").is_dir()
+        )
+    return dirs
+
+
+# ---------------------------------------------------------------------------
+# Engine agreement (acceptance criterion: static peaks == measured
+# residency on the fixture corpus)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "trace_dir", _corpus_dirs(), ids=lambda d: d.name,
+)
+def test_liveness_agrees_with_engine(trace_dir):
+    from tpusim.timing.engine import (
+        _vmem_peak_live_bytes, _vmem_resident_bytes,
+    )
+    from tpusim.trace.format import load_trace
+
+    pod = load_trace(trace_dir)
+    assert pod.modules
+    for name, module in pod.modules.items():
+        df = analyze_module(module)
+        assert df.alloc_total("vmem") == _vmem_resident_bytes(module), (
+            f"{trace_dir.name}/{name}: residency sum diverged"
+        )
+        assert df.peak_live("vmem") == _vmem_peak_live_bytes(module), (
+            f"{trace_dir.name}/{name}: peak-live diverged"
+        )
+        # HBM peaks are positive for any module with real buffers and
+        # bounded by the conservative allocation sum
+        assert 0 < df.peak_live("hbm") <= df.alloc_total("hbm")
+
+
+def test_analyze_module_memoizes_on_the_module():
+    mod = parse_hlo_module(
+        "HloModule m\n\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n"
+        "}\n",
+        name_hint="m",
+    )
+    assert analyze_module(mod) is analyze_module(mod)
+
+
+# ---------------------------------------------------------------------------
+# Def-use chains + intervals
+# ---------------------------------------------------------------------------
+
+
+def test_def_use_chains_and_schedule_defects():
+    from tpusim.analysis.dataflow import ModuleDataflowBuilder
+
+    mod = parse_hlo_module(
+        "HloModule m\n\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  %a = f32[8]{0} add(%p0, %b)\n"       # %b used before def
+        "  %b = f32[8]{0} negate(%p0)\n"
+        "  ROOT %r = f32[8]{0} add(%a, %ghost)\n"  # %ghost undefined
+        "}\n",
+        name_hint="m",
+    )
+    comp = mod.entry
+    cdf = ModuleDataflowBuilder().feed(comp, is_entry=True)
+    assert not cdf.schedule_ok
+    assert cdf.undefined == [(3, "ghost")]
+    assert cdf.misordered == [(1, "b", 2)]
+    assert cdf.defs["a"] == 1
+    assert cdf.uses["p0"] == [1, 2]
+    assert cdf.uses["a"] == [3]
+
+
+def test_liveness_intervals_cover_def_to_last_use():
+    from tpusim.analysis.dataflow import ModuleDataflowBuilder
+
+    mod = parse_hlo_module(
+        "HloModule m\n\n"
+        "ENTRY %main (p0: f32[1024]) -> f32[1024] {\n"
+        "  %p0 = f32[1024]{0} parameter(0)\n"
+        "  %a = f32[1024]{0} negate(%p0)\n"
+        "  %b = f32[1024]{0} negate(%a)\n"
+        "  ROOT %r = f32[1024]{0} add(%b, %b)\n"
+        "}\n",
+        name_hint="m",
+    )
+    cdf = ModuleDataflowBuilder().feed(mod.entry, is_entry=True)
+    spans = {iv.name: (iv.start, iv.end) for iv in cdf.intervals
+             if iv.space == "hbm"}
+    assert spans["p0"] == (0, 1)     # param dies at %a
+    assert spans["a"] == (1, 2)      # dies at %b
+    assert spans["b"] == (2, 3)      # dies at the root
+    # 4 KiB buffers: peak is two concurrently-live (operand + result)
+    assert cdf.summary.local_peak["hbm"] == 2 * 4096
+    assert cdf.summary.alloc["hbm"] == 4 * 4096
+
+
+def test_alias_extension_keeps_source_alive():
+    """A get-tuple-element alias extends its operand's lifetime: the
+    underlying buffer lives until the alias's own last use."""
+    from tpusim.analysis.dataflow import ModuleDataflowBuilder
+
+    mod = parse_hlo_module(
+        "HloModule m\n\n"
+        "ENTRY %main (p0: f32[1024]) -> f32[1024] {\n"
+        "  %p0 = f32[1024]{0} parameter(0)\n"
+        "  %t = (f32[1024]{0}) tuple(%p0)\n"
+        "  %g = f32[1024]{0} get-tuple-element(%t), index=0\n"
+        "  %x = f32[1024]{0} negate(%p0)\n"
+        "  ROOT %r = f32[1024]{0} add(%g, %x)\n"
+        "}\n",
+        name_hint="m",
+    )
+    cdf = ModuleDataflowBuilder().feed(mod.entry, is_entry=True)
+    spans = {iv.name: (iv.start, iv.end) for iv in cdf.intervals}
+    # p0 must live to the root (index 4) through the %t -> %g chain,
+    # not die at its last direct use
+    assert spans["p0"][1] == 4
+
+
+# ---------------------------------------------------------------------------
+# Collective matching: no false positives on legal schedules
+# ---------------------------------------------------------------------------
+
+
+def _pt_with_commands(tmp_path, commands):
+    from tpusim.analysis.trace_passes import load_parsed_trace
+
+    root = tmp_path / "trace"
+    (root / "modules").mkdir(parents=True)
+    (root / "modules" / "m.hlo").write_text(
+        "HloModule m, num_partitions=4\n\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  ROOT %r = f32[8]{0} negate(%p0)\n"
+        "}\n"
+    )
+    (root / "meta.json").write_text(
+        json.dumps({"num_devices": 4, "device_kind": "cpu"})
+    )
+    (root / "commandlist.jsonl").write_text(
+        "\n".join(json.dumps(c) for c in commands) + "\n"
+    )
+    return load_parsed_trace(root)
+
+
+def _coll(device, kind, groups, nbytes=1024):
+    return {
+        "kind": "collective", "device": device, "bytes": nbytes,
+        "collective": {"kind": kind, "replica_groups": groups},
+    }
+
+
+def test_matching_healthy_multi_device_stream_is_clean(tmp_path):
+    from tpusim.analysis.collective_passes import run_collective_matching
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    pt = _pt_with_commands(tmp_path, [
+        {"kind": "kernel_launch", "module": "m", "device": 0},
+        {"kind": "kernel_launch", "module": "m", "device": 1},
+        _coll(0, "all-reduce", [[0, 1]]),
+        _coll(1, "all-reduce", [[0, 1]]),
+        _coll(0, "all-gather", [[0, 1]]),
+        _coll(1, "all-gather", [[0, 1]]),
+    ])
+    diags = Diagnostics()
+    run_collective_matching(pt, diags)
+    assert diags.items == [], "\n".join(diags.text_lines())
+
+
+def test_matching_staggered_disjoint_groups_is_clean(tmp_path):
+    """Device 0 waits on {0,1} while devices 1,2 legally complete
+    {1,2} first — disjoint groups rendezvous in any order; only a
+    whole-pod stall is a deadlock."""
+    from tpusim.analysis.collective_passes import run_collective_matching
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    pt = _pt_with_commands(tmp_path, [
+        {"kind": "kernel_launch", "module": "m", "device": 0},
+        _coll(0, "all-reduce", [[0, 1], [2, 3]]),
+        _coll(1, "all-to-all", [[1, 2]]),
+        _coll(2, "all-to-all", [[1, 2]]),
+        _coll(1, "all-reduce", [[0, 1], [2, 3]]),
+        _coll(2, "all-reduce", [[0, 1], [2, 3]]),
+        _coll(3, "all-reduce", [[0, 1], [2, 3]]),
+    ])
+    diags = Diagnostics()
+    run_collective_matching(pt, diags)
+    assert diags.items == [], "\n".join(diags.text_lines())
+
+
+def test_matching_single_device_capture_is_exempt(tmp_path):
+    """The normal trace-one-replay-many capture: one device's stream
+    issues collectives whose groups cover the whole declared pod —
+    there are no peer streams to align, so the matcher stays silent."""
+    from tpusim.analysis.collective_passes import run_collective_matching
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    pt = _pt_with_commands(tmp_path, [
+        {"kind": "kernel_launch", "module": "m", "device": 0},
+        _coll(0, "all-reduce", [[0, 1], [2, 3]]),
+    ])
+    diags = Diagnostics()
+    run_collective_matching(pt, diags)
+    assert diags.items == []
+
+
+def test_matching_reports_one_stall_not_a_cascade(tmp_path):
+    from tpusim.analysis.collective_passes import run_collective_matching
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    pt = _pt_with_commands(tmp_path, [
+        {"kind": "kernel_launch", "module": "m", "device": 0},
+        _coll(0, "all-reduce", [[0, 1]]),
+        _coll(1, "all-gather", [[0, 1]]),
+        # everything after the broken rendezvous is speculative
+        _coll(0, "reduce-scatter", [[0, 1]]),
+        _coll(1, "collective-permute", [[0, 1]]),
+    ])
+    diags = Diagnostics()
+    run_collective_matching(pt, diags)
+    assert [d.code for d in diags.items] == ["TL410"]
+
+
+# ---------------------------------------------------------------------------
+# Self-audit mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_selfaudit_pragma_suppresses_with_reason(tmp_path):
+    from tpusim.analysis import analyze_self_audit
+
+    root = tmp_path / "repo"
+    (root / "tpusim" / "campaign").mkdir(parents=True)
+    (root / "tpusim" / "campaign" / "x.py").write_text(
+        "import random\n"
+        "def draw():\n"
+        "    # lint-allow: TL350 entropy for a non-deterministic id\n"
+        "    return random.random()\n"
+    )
+    assert analyze_self_audit(root=root).items == []
+
+
+def test_selfaudit_pragma_reason_may_start_uppercase(tmp_path):
+    """The pragma captures CODES only — an uppercase-leading reason
+    ('CI artifact', 'RNG for ids') must not be swallowed into the
+    code token and silently break the suppression it documents."""
+    from tpusim.analysis import analyze_self_audit
+
+    root = tmp_path / "repo"
+    (root / "tpusim" / "campaign").mkdir(parents=True)
+    (root / "tpusim" / "campaign" / "x.py").write_text(
+        "import random\n"
+        "def draw():\n"
+        "    # lint-allow: TL350 RNG seeds a non-replayed id\n"
+        "    return random.random()\n"
+    )
+    assert analyze_self_audit(root=root).items == []
+
+
+def test_selfaudit_fsync_helper_satisfies_the_staging_rule(tmp_path):
+    from tpusim.analysis import analyze_self_audit
+
+    root = tmp_path / "repo"
+    (root / "tpusim" / "store").mkdir(parents=True)
+    (root / "tpusim" / "store" / "x.py").write_text(
+        "import os\n"
+        "def _stage(tmp, data):\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(data)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "def publish(tmp, path, data):\n"
+        "    _stage(tmp, data)\n"
+        "    os.replace(tmp, path)\n"
+        "def torn_publish(tmp, path):\n"
+        "    os.replace(tmp, path)\n"
+    )
+    diags = analyze_self_audit(root=root)
+    assert [d.code for d in diags.items] == ["TL352"]
+    (d,) = diags.items
+    assert d.line == 11  # only the helper-less publish fires
+
+
+def test_selfaudit_seeded_constructors_are_legal(tmp_path):
+    from tpusim.analysis import analyze_self_audit
+
+    root = tmp_path / "repo"
+    (root / "tpusim" / "fleet").mkdir(parents=True)
+    (root / "tpusim" / "fleet" / "x.py").write_text(
+        "import random\n"
+        "def stream(seed):\n"
+        "    rng = random.Random(f'{seed}:arrivals')\n"
+        "    return rng.random()\n"
+    )
+    assert analyze_self_audit(root=root).items == []
+
+
+def test_repo_selfaudit_is_green():
+    """The acceptance gate: the TL35x audit over tpusim/ itself."""
+    from tpusim.analysis import analyze_self_audit
+
+    diags = analyze_self_audit()
+    assert diags.items == [], "\n".join(diags.text_lines())
+
+
+# ---------------------------------------------------------------------------
+# Streaming lint: deferred == eager, and the RSS bound
+# ---------------------------------------------------------------------------
+
+
+def _write_big_trace(tdir: Path, n_comps: int, n_ops: int,
+                     pad: int = 580) -> Path:
+    (tdir / "modules").mkdir(parents=True)
+    (tdir / "meta.json").write_text(json.dumps({
+        "format_version": 1, "num_devices": 1, "device_kind": "cpu",
+    }))
+    filler = "x" * pad
+    hlo = tdir / "modules" / "giant.hlo"
+    with open(hlo, "w") as f:
+        f.write("HloModule giant_lint, is_scheduled=true\n\n")
+        for c in range(n_comps):
+            f.write(f"%body_{c} (p0: f32[512,512]) -> f32[512,512] {{\n")
+            f.write("  %p0 = f32[512,512]{1,0:T(8,128)} parameter(0)\n")
+            prev = "%p0"
+            for i in range(n_ops):
+                f.write(
+                    f"  %add_{i} = f32[512,512]{{1,0:T(8,128)}} "
+                    f"add({prev}, %p0), metadata={{op_name="
+                    f"\"layer{c}/add{i}/{filler}\" "
+                    f"source_file=\"g.py\" source_line={i}}}\n"
+                )
+                prev = f"%add_{i}"
+            f.write(f"  ROOT %root = f32[512,512]{{1,0:T(8,128)}} "
+                    f"copy({prev})\n}}\n\n")
+        f.write("ENTRY %main (p0: f32[512,512]) -> f32[512,512] {\n")
+        f.write("  %p0 = f32[512,512]{1,0:T(8,128)} parameter(0)\n")
+        prev = "%p0"
+        for c in range(n_comps):
+            f.write(f"  %call_{c} = f32[512,512]{{1,0:T(8,128)}} "
+                    f"call({prev}), to_apply=%body_{c}\n")
+            prev = f"%call_{c}"
+        f.write(f"  ROOT %out = f32[512,512]{{1,0:T(8,128)}} "
+                f"copy({prev})\n}}\n")
+    (tdir / "commandlist.jsonl").write_text(json.dumps(
+        {"kind": "kernel_launch", "module": "giant", "device": 0}
+    ) + "\n")
+    return hlo
+
+
+def test_deferred_module_meta_parses_at_load(tmp_path, monkeypatch):
+    from tpusim.analysis.trace_passes import load_parsed_trace
+
+    _write_big_trace(tmp_path / "t", n_comps=2, n_ops=5, pad=8)
+    monkeypatch.setenv("TPUSIM_STREAM_THRESHOLD", "64")
+    pt = load_parsed_trace(tmp_path / "t")
+    pm = pt.modules["giant"]
+    assert pm.deferred_path is not None
+    assert pm.module.name == "giant_lint"
+    # nothing parsed yet: the header scan stops at the HloModule line
+    assert not pm.comp_lines and not pm.op_lines
+
+
+_LINT_RSS_SNIPPET = r'''
+import json, resource, sys
+from tpusim.analysis import analyze_trace_dir
+
+if sys.argv[1] == "--baseline":
+    print(json.dumps({
+        "peak_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+    }))
+    raise SystemExit(0)
+diags = analyze_trace_dir(sys.argv[1], arch="v5e", tuned=False)
+print(json.dumps({
+    "peak_kb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+    "errors": sum(1 for d in diags.items
+                  if d.severity.value == "error"),
+}))
+'''
+
+
+@pytest.mark.slow
+def test_streaming_lint_bounded_rss(tmp_path):
+    """Acceptance (satellite): ``tpusim lint`` on a streaming-scale
+    trace holds the streaming RSS bound — the same harness discipline
+    as the pricing-path test in test_fastpath.py: subprocess-isolated
+    ru_maxrss, a same-session import-floor baseline, and an absolute
+    cap that full-text materialization (or retained per-op IR/line
+    maps, which cost several times the text) trips decisively."""
+    tdir = tmp_path / "giant"
+    hlo = _write_big_trace(tdir, n_comps=100, n_ops=1000)
+    size = hlo.stat().st_size
+    assert size >= 64 * 1024 * 1024, f"generator produced {size} bytes"
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("TPUSIM_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    base = subprocess.run(
+        [sys.executable, "-c", _LINT_RSS_SNIPPET, "--baseline"],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+    )
+    assert base.returncode == 0, base.stderr[-2000:]
+    baseline = json.loads(
+        base.stdout.strip().splitlines()[-1]
+    )["peak_kb"] * 1024
+
+    proc = subprocess.run(
+        [sys.executable, "-c", _LINT_RSS_SNIPPET, str(tdir)],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["errors"] == 0
+    peak = out["peak_kb"] * 1024
+    assert peak - baseline < 0.35 * size, (
+        f"streaming lint added {(peak - baseline) / 1e6:.0f} MB over "
+        f"the {baseline / 1e6:.0f} MB import floor — not well below "
+        f"the {size / 1e6:.0f} MB trace"
+    )
+    assert peak < baseline + 0.5 * size, (
+        f"absolute peak RSS {peak / 1e6:.0f} MB over the "
+        f"{baseline / 1e6:.0f} MB floor is too close to the "
+        f"{size / 1e6:.0f} MB trace size (full-text "
+        f"materialization?)"
+    )

@@ -274,6 +274,155 @@ def test_error_diagnostics_reject_as_400(broken_root):
 
 
 # ---------------------------------------------------------------------------
+# strict lint (serve --strict-lint): 422 + diagnostics doc, verdict
+# cached by content hash
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def warned_root(tmp_path_factory):
+    """A trace whose lint verdict is warning-only (TL015 zero-byte
+    standalone collective): the default pre-flight admits it, the
+    strict-lint gate refuses it."""
+    root = tmp_path_factory.mktemp("serve_warned_root")
+    td = root / "warned"
+    (td / "modules").mkdir(parents=True)
+    src = FIXTURES / "matmul_512" / "modules" / "matmul_512.hlo"
+    (td / "modules" / "matmul_512.hlo").write_text(src.read_text())
+    (td / "meta.json").write_text(
+        json.dumps({"num_devices": 4, "format_version": 1})
+    )
+    (td / "commandlist.jsonl").write_text("\n".join([
+        json.dumps({"kind": "kernel_launch",
+                    "module": "matmul_512", "device": 0}),
+        json.dumps({"kind": "collective", "device": 0, "bytes": 0,
+                    "collective": {"kind": "all-reduce",
+                                   "replica_groups": [[0, 1], [2, 3]]}}),
+    ]) + "\n")
+    return root
+
+
+def test_strict_lint_refuses_warnings_as_422(warned_root):
+    with ServeDaemon(trace_root=warned_root, strict_lint=True) as d:
+        c = ServeClient(d.url)
+        with pytest.raises(ServeError) as ei:
+            c.simulate(trace="warned", arch="v5e")
+        err = ei.value
+        assert err.status == 422
+        assert err.code == "strict_lint_refused"
+        assert any(
+            item["code"] == "TL015" for item in err.diagnostics
+        )
+        # the repeat is refused from the CACHED verdict: one verdict
+        # entry, two refusals, zero re-lints
+        with pytest.raises(ServeError) as ei:
+            c.simulate(trace="warned", arch="v5e")
+        assert ei.value.status == 422
+        stats = d.worker.stats_dict()
+        assert stats["strict_lint_refused_total"] == 2
+        assert stats["lint_verdicts_cached"] == 1
+        # nothing priced
+        assert d.worker.priced == 0
+
+
+def test_strict_lint_verdicts_do_not_cross_serve_commandlists(
+    tmp_path_factory,
+):
+    """Two traces sharing byte-identical modules but DIFFERENT
+    commandlists must each get their own verdict: the trace passes
+    judge both artifacts, so the cache key carries a commandlist
+    fingerprint beside the module content hashes."""
+    root = tmp_path_factory.mktemp("serve_twin_root")
+    src = FIXTURES / "matmul_512" / "modules" / "matmul_512.hlo"
+    for name, extra in (("clean", []), ("dirty", [
+        json.dumps({"kind": "collective", "device": 0, "bytes": 0,
+                    "collective": {"kind": "all-reduce",
+                                   "replica_groups": [[0, 1], [2, 3]]}}),
+    ])):
+        td = root / name
+        (td / "modules").mkdir(parents=True)
+        (td / "modules" / "matmul_512.hlo").write_text(src.read_text())
+        (td / "meta.json").write_text(
+            json.dumps({"num_devices": 4, "format_version": 1})
+        )
+        (td / "commandlist.jsonl").write_text("\n".join(
+            [json.dumps({"kind": "kernel_launch",
+                         "module": "matmul_512", "device": 0})] + extra
+        ) + "\n")
+    # a third twin differing only in META (pod declaration 1 -> the
+    # device-0 launch is still fine, but a dirty meta declaring fewer
+    # devices than a command uses would TL007): meta must join the key
+    td = root / "metatwin"
+    (td / "modules").mkdir(parents=True)
+    (td / "modules" / "matmul_512.hlo").write_text(src.read_text())
+    (td / "meta.json").write_text(
+        json.dumps({"num_devices": 1, "format_version": 1})
+    )
+    (td / "commandlist.jsonl").write_text(
+        json.dumps({"kind": "kernel_launch",
+                    "module": "matmul_512", "device": 0}) + "\n"
+    )
+    with ServeDaemon(trace_root=root, strict_lint=True) as d:
+        c = ServeClient(d.url)
+        # the clean twin admits first — its verdict must NOT admit the
+        # dirty twin afterwards (nor vice versa on a fresh daemon)
+        r = c.simulate(trace="clean", arch="v5e")
+        assert r.stats["sim_cycle"] > 0
+        with pytest.raises(ServeError) as ei:
+            c.simulate(trace="dirty", arch="v5e")
+        assert ei.value.status == 422
+        r = c.simulate(trace="metatwin", arch="v5e")
+        assert r.stats["sim_cycle"] > 0
+        # three distinct verdicts: modules alone must not key the cache
+        assert d.worker.stats_dict()["lint_verdicts_cached"] == 3
+
+
+def test_strict_lint_admits_clean_traces(warned_root):
+    with ServeDaemon(trace_root=FIXTURES, strict_lint=True) as d:
+        c = ServeClient(d.url)
+        r = c.simulate(trace="matmul_512", arch="v5e")
+        assert r.stats["sim_cycle"] > 0
+        r2 = c.simulate(trace="matmul_512", arch="v5e")
+        assert r2.cache_hit
+        stats = d.worker.stats_dict()
+        assert stats["strict_lint_refused_total"] == 0
+        assert stats["lint_verdicts_cached"] == 1
+
+
+def test_strict_lint_off_keeps_warning_traces_servable(warned_root):
+    """The default daemon admits warning-only traces — strict lint is
+    an opt-in tightening, not a behavior change."""
+    with ServeDaemon(trace_root=warned_root) as d:
+        c = ServeClient(d.url)
+        r = c.simulate(trace="warned", arch="v5e")
+        assert r.stats["sim_cycle"] > 0
+        assert "strict_lint_refused_total" not in d.worker.stats_dict()
+
+
+def test_strict_lint_inline_hlo_verdict_cached_by_content(warned_root):
+    bad_inline = (
+        "HloModule bad, num_partitions=4\n\n"
+        "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+        "  %p0 = f32[8]{0} parameter(0)\n"
+        "  ROOT %r = f32[8]{0} add(%p0, %ghost)\n"
+        "}\n"
+    )
+    with ServeDaemon(trace_root=None, strict_lint=True) as d:
+        c = ServeClient(d.url)
+        for _ in range(2):
+            with pytest.raises(ServeError) as ei:
+                c.simulate(hlo_text=bad_inline, arch="v5e")
+            assert ei.value.status == 422
+            assert any(
+                item["code"] == "TL001"
+                for item in ei.value.diagnostics
+            )
+        stats = d.worker.stats_dict()
+        assert stats["strict_lint_refused_total"] == 2
+        assert stats["lint_verdicts_cached"] == 1
+
+
+# ---------------------------------------------------------------------------
 # admission: 429 / 504 / 413
 # ---------------------------------------------------------------------------
 

@@ -810,6 +810,7 @@ def _cmd_serve_front(args: argparse.Namespace) -> int:
         "max_worker_rss": args.max_worker_rss,
         "compile_cache": args.compile_cache,
         "hot_cache": args.hot_cache,
+        "strict_lint": args.strict_lint,
         "quarantine_dir": quarantine_dir,
     }
     front = FrontSupervisor(
@@ -871,6 +872,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             max_worker_rss=args.max_worker_rss,
             compile_cache=args.compile_cache,
             hot_cache=args.hot_cache,
+            strict_lint=args.strict_lint,
         )
     except ValueError as e:
         # a quota/size typo must refuse loudly, not bound nothing
@@ -986,10 +988,12 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         for line in list_code_lines():
             print(line)
         return 0
-    if args.trace is None and not args.stats_keys and not args.campaign \
+    if args.trace is None and not args.stats_keys \
+            and not args.self_audit and not args.campaign \
             and not args.advise:
         print("tpusim lint: nothing to analyze — pass a trace dir, "
-              "--campaign, --advise, --stats-keys, or --list-codes",
+              "--campaign, --advise, --stats-keys, --self-audit, or "
+              "--list-codes",
               file=sys.stderr)
         return 2
     if args.trace is None and (args.faults or args.config or args.arch):
@@ -1027,6 +1031,10 @@ def _cmd_lint(args: argparse.Namespace) -> int:
             )
     if args.stats_keys:
         analyze_stats_keys(diags=diags)
+    if args.self_audit:
+        from tpusim.analysis import analyze_self_audit
+
+        analyze_self_audit(diags=diags)
 
     if args.format == "json":
         print(diags.to_json())
@@ -1810,6 +1818,12 @@ def main(argv: list[str] | None = None) -> int:
                           "from the map: no dispatch, no re-pricing, "
                           "no re-serialization; invalidated by model/"
                           "format/tuned-overlay changes")
+    psv.add_argument("--strict-lint", action="store_true",
+                     help="refuse (422 + the diagnostics doc) any "
+                          "simulate request whose trace-family lint "
+                          "passes report errors OR warnings; the "
+                          "verdict is cached by content hash, so the "
+                          "fleet lints each distinct trace once")
     psv.add_argument("--verbose", action="store_true",
                      help="per-request access log on stderr")
     psv.set_defaults(fn=_cmd_serve)
@@ -1901,10 +1915,20 @@ def main(argv: list[str] | None = None) -> int:
     pli.add_argument("--stats-keys", action="store_true",
                      help="also audit the repo's obs_/faults_/ici_ "
                           "stats-key namespaces (ownership, collisions, "
-                          "schema agreement)")
+                          "schema agreement); exit 0 when the audit is "
+                          "clean, 1 on any error-level finding (the "
+                          "same gate as trace diagnostics)")
+    pli.add_argument("--self-audit", action="store_true",
+                     help="run the TL35x determinism/durability "
+                          "self-audit over the repo's own sources "
+                          "(unseeded RNG / wall-clock in seeded "
+                          "subsystems, os.replace without "
+                          "fsync-before-replace staging); exit 1 on "
+                          "findings")
     pli.add_argument("--list-codes", action="store_true",
-                     help="print the diagnostic registry (code, "
-                          "severity, one-liner) and exit")
+                     help="print the diagnostic registry grouped by "
+                          "family with the owning pass module, and "
+                          "exit")
     pli.set_defaults(fn=_cmd_lint)
 
     pi = sub.add_parser("info", help="describe a stored trace")

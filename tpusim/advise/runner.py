@@ -34,10 +34,6 @@ __all__ = ["ADVISE_FORMAT_VERSION", "AdviseResult", "AdviseStats",
 
 ADVISE_FORMAT_VERSION = 1
 
-#: optimizer-state multiplier for the per-chip HBM residency estimate:
-#: a training step holds weights + gradients + one optimizer moment
-#: class alongside them (the capture's train step does exactly this)
-PARAM_STATE_MULT = 3.0
 
 
 @dataclass
@@ -139,28 +135,26 @@ def enumerate_cells(
 # ---------------------------------------------------------------------------
 
 
-def _residency_gib(
-    profile: WorkloadProfile, degrees: dict[str, int],
-) -> float:
-    """Per-chip HBM residency estimate (GiB): the parameter state
-    shards over the model axes (tp, pp, ep) and replicates over the
-    batch axes; activations shard over batch/sequence/stage and
-    replicate over tp.  An estimate by construction — the advisor's
-    fits-HBM flag, not a memory simulator."""
-    tp = degrees.get("tp", 1)
-    pp = degrees.get("pp", 1)
-    ep = degrees.get("ep", 1)
-    dp = degrees.get("dp", 1)
-    sp = degrees.get("sp", 1)
-    params = (
-        profile.param_bytes_total * PARAM_STATE_MULT
-        / max(tp * pp * ep, 1)
-    )
-    act_total = sum(
-        s.payload_bytes for s in profile.tp_sites
-    ) * profile.dp0
-    acts = act_total / max(dp * sp * pp, 1)
-    return (params + acts) / float(1 << 30)
+def _residency_gib(module) -> float:
+    """Per-chip HBM residency (GiB): the dataflow engine's
+    aliasing-aware peak-live HBM bytes of the exact scaled module this
+    cell prices (``tpusim.analysis.dataflow``).  The same liveness
+    walk backs the TL400 "will not fit" lint error, so the ranked
+    table and the linter can never disagree about what fits —
+    replacing the PR 7 sharding heuristic, whose axis arithmetic could
+    drift arbitrarily far from what the priced module actually holds.
+
+    Known limit, inherited from the transform layer: ``scaled_module``
+    scales every tensor uniformly by chips*launches (pricing has the
+    same property), so cells at equal chip count report equal
+    residency regardless of WHICH axis shards — dp-replicated weights
+    and optimizer state beyond the captured step are outside the
+    capture.  The column describes the module the cell actually
+    prices; axis-aware weight layouts arrive with the transform layer,
+    not here."""
+    from tpusim.analysis.dataflow import analyze_module
+
+    return analyze_module(module).peak_live("hbm") / float(1 << 30)
 
 
 def run_advise(
@@ -304,7 +298,7 @@ def run_advise(
         if report.power is not None:
             watts = report.power.avg_watts
             energy = report.power.total_joules
-        resident_gib = _residency_gib(profile, degrees)
+        resident_gib = _residency_gib(compute)
         fits_hbm = resident_gib <= cfg.arch.hbm_gib
         slo_ok = (
             None if spec.slo is None

@@ -3,18 +3,26 @@
 Multi-pass static analysis with a shared diagnostics core: stable codes
 (``TL001``...), error/warning/info severities, ``file:line`` anchors
 into ``commandlist.jsonl`` / ``.hlo`` modules / schedule files, and a
-machine-readable JSON form.  Three pass families (trace, config,
-schedule) plus a repo-level stats-key contract audit.  Reached three
-ways: the ``tpusim lint`` CLI, the opt-in ``simulate --validate``
-pre-flight, and ``ci/check_golden.py --lint-smoke``.
+machine-readable JSON form.  Pass families: trace (syntax + dataflow
+over the whole-trace liveness engine in :mod:`~tpusim.analysis.
+dataflow`), config, schedule, campaign/advise/fleet specs, TL40x
+memory-capacity checks, TL41x cross-device collective-deadlock
+matching, the repo-level stats-key contract audit, and the TL35x
+determinism/durability self-audit of tpusim's own sources.  Reached
+four ways: the ``tpusim lint`` CLI, the opt-in ``simulate --validate``
+pre-flight, the serving tier (``serve --strict-lint`` content-hash-
+cached 422 refusals), and ``ci/check_golden.py --lint-smoke`` /
+``--dataflow-smoke``.
 """
 
 from tpusim.analysis.diagnostics import (
     CODES,
+    CODE_FAMILIES,
     CodeInfo,
     Diagnostic,
     Diagnostics,
     Severity,
+    family_of,
     list_code_lines,
 )
 from tpusim.analysis.advise_passes import analyze_advise_spec
@@ -24,6 +32,7 @@ from tpusim.analysis.runner import (
     ValidationError,
     analyze_config,
     analyze_schedule,
+    analyze_self_audit,
     analyze_stats_keys,
     analyze_trace_dir,
 )
@@ -31,6 +40,7 @@ from tpusim.analysis.statskeys import STATS_NAMESPACES
 
 __all__ = [
     "CODES",
+    "CODE_FAMILIES",
     "CodeInfo",
     "Diagnostic",
     "Diagnostics",
@@ -42,7 +52,9 @@ __all__ = [
     "analyze_config",
     "analyze_fleet_spec",
     "analyze_schedule",
+    "analyze_self_audit",
     "analyze_stats_keys",
     "analyze_trace_dir",
+    "family_of",
     "list_code_lines",
 ]

@@ -21,10 +21,12 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "CODES",
+    "CODE_FAMILIES",
     "CodeInfo",
     "Diagnostic",
     "Diagnostics",
     "Severity",
+    "family_of",
     "list_code_lines",
 ]
 
@@ -140,6 +142,30 @@ _code("TL301", _E, "stats key written outside its namespace's owning "
 _code("TL302", _W, "stats prefix not in the documented namespace registry")
 _code("TL303", _E, "schema-required stats key not found in audited "
                    "sources")
+
+# --- self-audit passes (TL35x) ---------------------------------------------
+_code("TL350", _E, "unseeded global-RNG draw inside a seeded subsystem")
+_code("TL351", _E, "wall-clock read inside a seeded subsystem")
+_code("TL352", _E, "os.replace publish without fsync-before-replace "
+                   "staging")
+
+# --- memory passes (TL40x) -------------------------------------------------
+_code("TL400", _E, "peak-live HBM bytes exceed the chosen arch's "
+                   "capacity (will not fit)")
+_code("TL401", _W, "peak-live vmem bytes exceed the arch budget (the "
+                   "engine prices the overflow as spill)")
+_code("TL402", _W, "peak-live HBM within 5% of the arch capacity "
+                   "(near-fit)")
+
+# --- collective-matching passes (TL41x) ------------------------------------
+_code("TL410", _E, "group members issue mismatched collective kinds at "
+                   "the matching position (deadlock)")
+_code("TL411", _E, "group members declare inconsistent replica groups "
+                   "for the matched collective (deadlock)")
+_code("TL412", _E, "a device never issues a collective its group is "
+                   "blocked on (hang)")
+_code("TL413", _E, "byte-count disagreement between matched collective "
+                   "participants")
 
 
 @dataclass(frozen=True)
@@ -272,10 +298,44 @@ class Diagnostics:
         )
 
 
+#: code-prefix -> (family name, owning pass module), longest match
+#: first — the ``--list-codes`` grouping and the docs table both read
+#: this, so a new family registers its owner exactly once
+CODE_FAMILIES: tuple[tuple[str, str, str], ...] = (
+    ("TL0", "trace passes", "tpusim/analysis/trace_passes.py"),
+    ("TL1", "config passes", "tpusim/analysis/config_passes.py"),
+    ("TL20", "schedule passes", "tpusim/analysis/schedule_passes.py"),
+    ("TL21", "campaign passes", "tpusim/analysis/campaign_passes.py"),
+    ("TL22", "advise passes", "tpusim/analysis/advise_passes.py"),
+    ("TL24", "fleet passes", "tpusim/analysis/fleet_passes.py"),
+    ("TL30", "stats-key contract", "tpusim/analysis/statskeys.py"),
+    ("TL35", "self-audit passes", "tpusim/analysis/selfaudit.py"),
+    ("TL40", "memory passes", "tpusim/analysis/memory_passes.py"),
+    ("TL41", "collective-matching passes",
+     "tpusim/analysis/collective_passes.py"),
+)
+
+
+def family_of(code: str) -> tuple[str, str]:
+    """(family name, owning pass module) for a registered code."""
+    best = ("", "unregistered", "")
+    for prefix, family, module in CODE_FAMILIES:
+        if code.startswith(prefix) and len(prefix) > len(best[0]):
+            best = (prefix, family, module)
+    return best[1], best[2]
+
+
 def list_code_lines() -> list[str]:
-    """The ``--list-codes`` table: one ``CODE severity summary`` line per
-    registered code, in code order (docs/CI cross-check this output)."""
-    return [
-        f"{c.code}  {c.severity.value:7s}  {c.summary}"
-        for c in sorted(CODES.values(), key=lambda c: c.code)
-    ]
+    """The ``--list-codes`` table, grouped by family with the owning
+    pass module: a ``[family — module]`` header line per group, then
+    one ``CODE severity summary`` line per registered code, in code
+    order (docs/CI cross-check this output)."""
+    lines: list[str] = []
+    last_family = None
+    for c in sorted(CODES.values(), key=lambda c: c.code):
+        family, module = family_of(c.code)
+        if family != last_family:
+            lines.append(f"[{family} — {module}]")
+            last_family = family
+        lines.append(f"{c.code}  {c.severity.value:7s}  {c.summary}")
+    return lines

@@ -14,7 +14,9 @@ from pathlib import Path
 
 from tpusim.analysis.diagnostics import Diagnostics
 from tpusim.analysis.config_passes import run_config_passes
+from tpusim.analysis.memory_passes import run_memory_passes
 from tpusim.analysis.schedule_passes import run_schedule_passes
+from tpusim.analysis.selfaudit import run_selfaudit_passes
 from tpusim.analysis.statskeys import run_statskey_passes
 from tpusim.analysis.trace_passes import (
     load_parsed_trace,
@@ -26,6 +28,7 @@ __all__ = [
     "analyze_trace_dir",
     "analyze_config",
     "analyze_schedule",
+    "analyze_self_audit",
     "analyze_stats_keys",
 ]
 
@@ -80,6 +83,18 @@ def analyze_stats_keys(
     return diags
 
 
+def analyze_self_audit(
+    diags: Diagnostics | None = None,
+    root: str | Path | None = None,
+) -> Diagnostics:
+    """TL35x determinism/durability self-audit over the repo sources
+    (``tpusim lint --self-audit``; the ``--dataflow-smoke`` CI tier
+    gates on it)."""
+    diags = diags if diags is not None else Diagnostics()
+    run_selfaudit_passes(diags, root=root)
+    return diags
+
+
 def analyze_trace_dir(
     trace_path: str | Path,
     arch: str | None = None,
@@ -120,6 +135,9 @@ def analyze_trace_dir(
         diags.emit("TL107", f"config does not compose: {e}")
         return diags
     run_config_passes(cfg, diags, trace_meta=pt.meta)
+    # TL40x: the dataflow liveness summaries the trace passes just
+    # built, judged against the composed arch's HBM/vmem capacities
+    run_memory_passes(pt, cfg, diags)
 
     if faults is not None:
         from tpusim.ici.topology import torus_for

@@ -7,9 +7,10 @@ verify the cross-artifact contracts a tpusim trace dir carries
 (``meta.json`` ↔ ``modules/*.hlo`` ↔ ``commandlist.jsonl``) *before*
 anything is priced:
 
-* **HLO dataflow** — def-before-use and schedule-order use (TL001/002),
-  opcode arity (TL003), elementwise shape/dtype agreement (TL004),
-  while body/condition shape contracts (TL005), called-computation
+* **HLO dataflow** — def-before-use and schedule-order use (TL001/002,
+  riding the def-use chains of :mod:`tpusim.analysis.dataflow`), opcode
+  arity (TL003), elementwise shape/dtype agreement (TL004), while
+  body/condition shape contracts (TL005), called-computation
   referential integrity (TL013), ENTRY presence (TL011);
 * **collective semantics** — result bytes vs operand shapes and group
   size (TL008), replica-group range/duplication (TL009) and pod tiling
@@ -17,21 +18,36 @@ anything is priced:
 * **commandlist referential integrity** — JSONL syntax (TL010), module
   references (TL006), device-id range (TL007), zero-byte standalone
   collectives (TL015);
+* **cross-device collective matching** — the TL41x deadlock shapes
+  (:mod:`tpusim.analysis.collective_passes`) over the aligned
+  per-device command streams;
 * **salvage damage** — malformed lines a lenient parse would skip
   (TL012).
 
 Anchors: every module diagnostic carries ``modules/<name>.hlo:<line>``
 and every command diagnostic ``commandlist.jsonl:<line>``, so findings
 are jump-to-able from an editor or CI log.
+
+**Streaming discipline**: every module pass consumes computations one
+at a time through :meth:`ParsedModule.iter_computations`.  Modules past
+the trace layer's streaming threshold are never materialized — the
+same line-anchored parser runs incrementally over the file, each
+computation is checked and summarized (def-use defects, liveness
+summary for the TL4xx memory passes, while/call signatures for the
+deferred cross-computation checks) and then dropped, so ``tpusim
+lint`` on a multi-GB pod holds the same RSS bound streaming pricing
+does.
 """
 
 from __future__ import annotations
 
 import gzip
 import json
+import os
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from tpusim.analysis.dataflow import ModuleDataflow, ModuleDataflowBuilder
 from tpusim.analysis.diagnostics import Diagnostics
 from tpusim.ir import (
     COLLECTIVE_OPCODES,
@@ -64,19 +80,131 @@ _AUX_SECTIONS = (
 )
 
 
+def _lint_stream_threshold() -> int:
+    """Module files at or past this size lint incrementally (deferred
+    per-computation parse) instead of materializing — the same
+    threshold + override the trace layer's streaming parse uses."""
+    from tpusim.trace.lazy import STREAM_THRESHOLD_BYTES
+
+    try:
+        return int(os.environ.get(
+            "TPUSIM_STREAM_THRESHOLD", STREAM_THRESHOLD_BYTES
+        ))
+    except ValueError:
+        return STREAM_THRESHOLD_BYTES
+
+
 @dataclass
 class ParsedModule:
-    """One module plus the artifact anchors the passes report against."""
+    """One module plus the artifact anchors the passes report against.
+
+    Eager form: ``module`` carries every parsed computation and
+    ``op_lines`` every op's line anchor.  Deferred form
+    (``deferred_path`` set): only the module header is parsed at load;
+    :meth:`iter_computations` re-walks the file one computation at a
+    time and nothing op-sized is retained."""
 
     key: str                     # trace key (file stem)
     file: str                    # anchor path, e.g. "modules/foo.hlo"
     module: ModuleTrace = field(default_factory=lambda: ModuleTrace(""))
-    #: (computation name, op name) -> 1-based line number
+    #: (computation name, op name) -> 1-based line number (eager only)
     op_lines: dict[tuple[str, str], int] = field(default_factory=dict)
     #: computation name -> header line number
     comp_lines: dict[str, int] = field(default_factory=dict)
     #: malformed lines a lenient parse would skip: (lineno, error)
     skipped: list[tuple[int, str]] = field(default_factory=list)
+    #: set for above-threshold modules: lint re-walks this file
+    #: incrementally instead of holding its text
+    deferred_path: Path | None = None
+    #: per-space liveness result, filled by run_trace_passes (the
+    #: TL4xx memory passes and advise consume it)
+    dataflow: ModuleDataflow | None = None
+
+    def iter_computations(self):
+        """Yield ``(comp, header_line, op_lines)`` per computation —
+        from memory (eager) or straight off the file (deferred)."""
+        if self.deferred_path is None:
+            by_comp: dict[str, dict[str, int]] = {}
+            for (cname, oname), line in self.op_lines.items():
+                by_comp.setdefault(cname, {})[oname] = line
+            for name, comp in self.module.computations.items():
+                yield (
+                    comp,
+                    self.comp_lines.get(name, 1),
+                    by_comp.get(name, {}),
+                )
+            return
+        feed = _ModuleLineFeed(self)
+        with open(self.deferred_path, "rt", errors="replace") as f:
+            for lineno, raw in enumerate(f, 1):
+                done = feed.feed(lineno, raw.rstrip("\n"))
+                if done is not None:
+                    yield done
+        done = feed.flush()
+        if done is not None:
+            yield done
+
+
+class _ModuleLineFeed:
+    """The incremental line-anchored parser both module forms share —
+    one state machine, so the eager and streaming lint paths can never
+    drift.  ``feed`` returns ``(comp, header_line, op_lines)`` when a
+    computation closes."""
+
+    def __init__(self, pm: ParsedModule):
+        self.pm = pm
+        self.current: Computation | None = None
+        self.current_line = 0
+        self.op_lines: dict[str, int] = {}
+
+    def feed(self, lineno: int, raw: str):
+        pm = self.pm
+        stripped = raw.strip()
+        if not stripped:
+            return None
+        if self.current is None and (
+            stripped in _AUX_SECTIONS or stripped[0].isdigit()
+        ):
+            return None
+        mm = _MODULE_RE.match(stripped)
+        if mm and self.current is None:
+            pm.module.name = mm.group("name")
+            parse_module_attrs(mm.group("attrs") or "", pm.module.meta)
+            return None
+        ch = _COMP_HEADER_RE.match(stripped)
+        if ch and self.current is None:
+            self.current = Computation(
+                name=ch.group("name"), is_entry=bool(ch.group("entry"))
+            )
+            self.current_line = lineno
+            self.op_lines = {}
+            pm.comp_lines[self.current.name] = lineno
+            if self.current.is_entry:
+                pm.module.entry_name = self.current.name
+            return None
+        if self.current is not None:
+            if stripped == "}":
+                return self._close()
+            try:
+                op = parse_instruction(stripped)
+            except ValueError as e:
+                pm.skipped.append((lineno, f"{stripped[:80]!r}: {e}"))
+                return None
+            if op is not None:
+                self.current.add(op)
+                self.op_lines[op.name] = lineno
+        return None
+
+    def _close(self):
+        done = (self.current, self.current_line, self.op_lines)
+        self.current = None
+        self.op_lines = {}
+        return done
+
+    def flush(self):
+        if self.current is not None:
+            return self._close()
+        return None
 
 
 @dataclass
@@ -131,50 +259,51 @@ class ParsedTrace:
 
 def _parse_module_lines(key: str, file: str, text: str) -> ParsedModule:
     pm = ParsedModule(key=key, file=file)
-    module = pm.module
-    module.name = key
-    current: Computation | None = None
+    pm.module.name = key
+    feed = _ModuleLineFeed(pm)
+
+    def retain(done) -> None:
+        comp, _line, op_lines = done
+        pm.module.add_computation(comp)
+        for oname, lineno in op_lines.items():
+            pm.op_lines[(comp.name, oname)] = lineno
+
     for lineno, raw in enumerate(text.splitlines(), 1):
-        stripped = raw.strip()
-        if not stripped:
-            continue
-        if current is None and (
-            stripped in _AUX_SECTIONS or stripped[0].isdigit()
-        ):
-            continue
-        mm = _MODULE_RE.match(stripped)
-        if mm and current is None:
-            module.name = mm.group("name")
-            parse_module_attrs(mm.group("attrs") or "", module.meta)
-            continue
-        ch = _COMP_HEADER_RE.match(stripped)
-        if ch and current is None:
-            current = Computation(
-                name=ch.group("name"), is_entry=bool(ch.group("entry"))
-            )
-            pm.comp_lines[current.name] = lineno
-            continue
-        if current is not None:
-            if stripped == "}":
-                module.add_computation(current)
-                current = None
-                continue
-            try:
-                op = parse_instruction(stripped)
-            except ValueError as e:
-                pm.skipped.append((lineno, f"{stripped[:80]!r}: {e}"))
-                continue
-            if op is not None:
-                current.add(op)
-                pm.op_lines[(current.name, op.name)] = lineno
-    if current is not None:
-        module.add_computation(current)
+        done = feed.feed(lineno, raw)
+        if done is not None:
+            retain(done)
+    done = feed.flush()
+    if done is not None:
+        retain(done)
+    return pm
+
+
+def _parse_module_header(key: str, file: str, path: Path) -> ParsedModule:
+    """Deferred form: parse only the ``HloModule`` header line (name +
+    meta — ``replay_devices`` needs ``num_partitions`` before any pass
+    runs), leave the computations on disk."""
+    pm = ParsedModule(key=key, file=file, deferred_path=path)
+    pm.module.name = key
+    with open(path, "rt", errors="replace") as f:
+        for _ in range(64):  # the header leads every XLA dump
+            line = f.readline()
+            if not line:
+                break
+            mm = _MODULE_RE.match(line.strip())
+            if mm:
+                pm.module.name = mm.group("name")
+                parse_module_attrs(
+                    mm.group("attrs") or "", pm.module.meta
+                )
+                break
     return pm
 
 
 def load_parsed_trace(path: str | Path) -> ParsedTrace:
     """Load a trace dir for analysis (never raises on artifact damage —
-    damage becomes diagnostics, that's the point)."""
+    damage becomes diagnostics, that's the point).  Module files at or
+    past the streaming threshold load in deferred form and are
+    re-walked one computation at a time by the passes."""
     from tpusim.trace.format import iter_commandlist
 
     path = Path(path)
@@ -192,14 +321,26 @@ def load_parsed_trace(path: str | Path) -> ParsedTrace:
                 pt.meta_error = "meta.json is not an object"
                 pt.meta = {}
 
+    threshold = _lint_stream_threshold()
     modules_dir = path / "modules"
     if modules_dir.is_dir():
         # parse each module as it is read — holding every module's text
-        # at once would double peak memory on multi-GB trace dirs
+        # at once would double peak memory on multi-GB trace dirs; past
+        # the streaming threshold the text is never held at all
         for mp in sorted(modules_dir.glob("*.hlo")):
-            pt.modules[mp.stem] = _parse_module_lines(
-                mp.stem, f"modules/{mp.name}", mp.read_text()
-            )
+            anchor = f"modules/{mp.name}"
+            try:
+                big = mp.stat().st_size >= threshold
+            except OSError:
+                big = False
+            if big:
+                pt.modules[mp.stem] = _parse_module_header(
+                    mp.stem, anchor, mp
+                )
+            else:
+                pt.modules[mp.stem] = _parse_module_lines(
+                    mp.stem, anchor, mp.read_text()
+                )
         for mp in sorted(modules_dir.glob("*.hlo.gz")):
             key = mp.name[: -len(".hlo.gz")]
             with gzip.open(mp, "rt") as f:
@@ -265,38 +406,103 @@ def _expected_arity(base: str) -> int | None:
 
 
 # ---------------------------------------------------------------------------
-# Passes
+# Per-computation passes (fed one computation at a time)
 # ---------------------------------------------------------------------------
 
 
-def _check_dataflow(pm: ParsedModule, diags: Diagnostics) -> None:
-    """TL001/TL002 def-before-use, TL003 arity, TL004 elementwise shape/
-    dtype consistency, TL013 called-computation integrity."""
-    module = pm.module
-    for comp in module.computations.values():
-        pos = {op.name: i for i, op in enumerate(comp.ops)}
+@dataclass(frozen=True)
+class _CompSig:
+    """The O(1) signature of a computation the deferred
+    cross-computation checks (TL005 while contracts) resolve against
+    after the module's one-at-a-time walk completes."""
+
+    n_params: int
+    param0_key: object
+    param0_str: str
+    root_key: object
+    root_str: str
+    root_is_scalar_pred: bool
+    has_ops: bool
+
+
+def _comp_sig(comp: Computation) -> _CompSig:
+    params = comp.parameters
+    root = comp.root if comp.ops else None
+    r = root.result if root is not None else None
+    return _CompSig(
+        n_params=len(params),
+        param0_key=(
+            _shape_key(params[0].result) if params else None
+        ),
+        param0_str=str(params[0].result) if params else "",
+        root_key=_shape_key(r) if r is not None else None,
+        root_str=str(r) if r is not None else "",
+        root_is_scalar_pred=bool(
+            isinstance(r, TensorSpec)
+            and r.dtype == "pred" and r.shape == ()
+        ),
+        has_ops=bool(comp.ops),
+    )
+
+
+@dataclass
+class _PendingWhile:
+    """One while op awaiting its body/condition signatures."""
+
+    comp_name: str
+    op_name: str
+    result_str: str
+    want: object
+    body: str
+    cond: str
+    line: int | None
+
+
+class _ModuleChecks:
+    """All module-family passes over one module, one computation at a
+    time.  Cross-computation state is O(#computations + #unresolved
+    references), never O(ops) — the streaming lint bound."""
+
+    def __init__(self, pm: ParsedModule, diags: Diagnostics):
+        self.pm = pm
+        self.diags = diags
+        self.builder = ModuleDataflowBuilder()
+        self.sigs: dict[str, _CompSig] = {}
+        #: called targets not yet seen: name -> [(comp, op, line)]
+        self.pending_called: dict[str, list] = {}
+        self.pending_while: list[_PendingWhile] = []
+
+    def feed(self, comp: Computation, op_lines: dict[str, int]) -> None:
+        pm, diags = self.pm, self.diags
+        module = pm.module
+        is_entry = comp.is_entry or module.entry_name == comp.name
+        cdf = self.builder.feed(comp, is_entry)
+        pos = cdf.defs
 
         def anchor(op: TraceOp) -> int | None:
-            return pm.op_lines.get((comp.name, op.name))
+            return op_lines.get(op.name)
+
+        # TL001/TL002 straight off the def-use chains
+        for i, operand in cdf.undefined:
+            op = comp.ops[i]
+            diags.emit(
+                "TL001",
+                f"{module.name}/{comp.name}: %{op.name} reads "
+                f"%{operand}, which is never defined in this "
+                f"computation",
+                file=pm.file, line=anchor(op),
+            )
+        for i, operand, j in cdf.misordered:
+            op = comp.ops[i]
+            diags.emit(
+                "TL002",
+                f"{module.name}/{comp.name}: %{op.name} reads "
+                f"%{operand} before its definition (schedule "
+                f"position {j} >= {i})",
+                file=pm.file, line=anchor(op),
+            )
 
         for i, op in enumerate(comp.ops):
-            for operand in op.operands:
-                if operand not in pos:
-                    diags.emit(
-                        "TL001",
-                        f"{module.name}/{comp.name}: %{op.name} reads "
-                        f"%{operand}, which is never defined in this "
-                        f"computation",
-                        file=pm.file, line=anchor(op),
-                    )
-                elif pos[operand] >= i:
-                    diags.emit(
-                        "TL002",
-                        f"{module.name}/{comp.name}: %{op.name} reads "
-                        f"%{operand} before its definition (schedule "
-                        f"position {pos[operand]} >= {i})",
-                        file=pm.file, line=anchor(op),
-                    )
             base = op.base
             want = _expected_arity(base)
             if want is not None and len(op.operands) != want:
@@ -308,14 +514,25 @@ def _check_dataflow(pm: ParsedModule, diags: Diagnostics) -> None:
                     file=pm.file, line=anchor(op),
                 )
             for called in op.called:
-                if called not in module.computations:
-                    diags.emit(
-                        "TL013",
-                        f"{module.name}/{comp.name}: %{op.name} calls "
-                        f"computation %{called}, which the module does "
-                        f"not contain (truncated trace?)",
-                        file=pm.file, line=anchor(op),
+                # XLA dumps define callees before callers, so almost
+                # every target resolves immediately; the rest wait for
+                # finish() (a target that never appears is TL013)
+                if called not in self.sigs and \
+                        called not in pm.comp_lines:
+                    self.pending_called.setdefault(called, []).append(
+                        (comp.name, op.name, anchor(op))
                     )
+            if base == "while":
+                line = anchor(op)
+                self.pending_while.append(_PendingWhile(
+                    comp_name=comp.name,
+                    op_name=op.name,
+                    result_str=str(op.result),
+                    want=_shape_key(op.result),
+                    body=op.attrs.get("body", "").lstrip("%"),
+                    cond=op.attrs.get("condition", "").lstrip("%"),
+                    line=line,
+                ))
             if (
                 base in _ELEMENTWISE_BINARY
                 and len(op.operands) == 2
@@ -343,63 +560,125 @@ def _check_dataflow(pm: ParsedModule, diags: Diagnostics) -> None:
                             file=pm.file, line=anchor(op),
                         )
 
+        self._check_collectives(comp, pos, op_lines)
+        self.sigs[comp.name] = _comp_sig(comp)
+        self.pending_called.pop(comp.name, None)
 
-def _check_while(pm: ParsedModule, diags: Diagnostics) -> None:
-    """TL005: while body/condition parameter/result shape agreement."""
-    module = pm.module
-    for comp in module.computations.values():
-        for op in comp.ops:
-            if op.base != "while":
+    def _check_collectives(
+        self, comp: Computation, pos: dict[str, int],
+        op_lines: dict[str, int],
+    ) -> None:
+        """TL008 byte-count consistency + TL009/TL014 on module
+        collectives."""
+        pm, diags = self.pm, self.diags
+        module = pm.module
+        for i, op in enumerate(comp.ops):
+            base = base_opcode(op.opcode)
+            if base not in COLLECTIVE_OPCODES or op.collective is None:
                 continue
-            line = pm.op_lines.get((comp.name, op.name))
-            body_name = op.attrs.get("body", "").lstrip("%")
-            cond_name = op.attrs.get("condition", "").lstrip("%")
-            want = _shape_key(op.result)
-            for role, name in (("body", body_name),
-                               ("condition", cond_name)):
-                sub = module.computations.get(name)
-                if sub is None:
+            line = op_lines.get(op.name)
+            ci = op.collective
+            _check_groups(
+                ci.replica_groups, module.num_devices,
+                f"{module.name}/{comp.name}: {op.opcode} %{op.name}",
+                diags, pm.file, line,
+            )
+            # byte-count relation: sync ops with resolvable operands only
+            # (async -start results interpose buffer tuples; variadic
+            # forms compare the summed element counts)
+            if op.is_async_start or op.is_async_done:
+                continue
+            in_elems = 0.0
+            ok = bool(op.operands)
+            for operand in op.operands:
+                j = pos.get(operand)
+                if j is None or j >= i:
+                    ok = False
+                    break
+                in_elems += comp.ops[j].result.elems
+            if not ok:
+                continue
+            out_elems = float(op.result.elems)
+            gs = ci.group_size if ci.replica_groups else None
+            expect: float | None = None
+            if base == "all-reduce":
+                expect = in_elems
+            elif base == "all-gather" and gs:
+                expect = in_elems * gs
+            elif base == "reduce-scatter" and gs:
+                expect = in_elems / gs
+            if expect is not None and out_elems != expect:
+                diags.emit(
+                    "TL008",
+                    f"{module.name}/{comp.name}: {base} %{op.name} "
+                    f"result has {out_elems:g} elements; operands "
+                    f"({in_elems:g} elements"
+                    + (f", group size {gs}" if gs else "")
+                    + f") imply {expect:g}",
+                    file=pm.file, line=line,
+                )
+
+    def finish(self, check_entry: bool) -> None:
+        pm, diags = self.pm, self.diags
+        module = pm.module
+        if check_entry and module.entry_name is None:
+            diags.emit(
+                "TL011",
+                f"module {module.name!r} has no ENTRY computation — "
+                f"the engine cannot replay it",
+                file=pm.file,
+                line=min(pm.comp_lines.values(), default=1),
+            )
+        for called, sites in sorted(self.pending_called.items()):
+            for comp_name, op_name, line in sites:
+                diags.emit(
+                    "TL013",
+                    f"{module.name}/{comp_name}: %{op_name} calls "
+                    f"computation %{called}, which the module does "
+                    f"not contain (truncated trace?)",
+                    file=pm.file, line=line,
+                )
+        for w in self.pending_while:
+            for role, name in (("body", w.body), ("condition", w.cond)):
+                sig = self.sigs.get(name)
+                if sig is None:
                     continue  # TL013 already reported missing targets
-                params = sub.parameters
-                if len(params) != 1:
+                if sig.n_params != 1:
                     diags.emit(
                         "TL005",
-                        f"{module.name}: while %{op.name} {role} "
-                        f"%{name} has {len(params)} parameters "
+                        f"{module.name}: while %{w.op_name} {role} "
+                        f"%{name} has {sig.n_params} parameters "
                         f"(expected exactly 1)",
-                        file=pm.file, line=line,
+                        file=pm.file, line=w.line,
                     )
                     continue
-                if _shape_key(params[0].result) != want:
+                if sig.param0_key != w.want:
                     diags.emit(
                         "TL005",
-                        f"{module.name}: while %{op.name} carries "
-                        f"{op.result} but {role} %{name} parameter is "
-                        f"{params[0].result}",
-                        file=pm.file, line=line,
+                        f"{module.name}: while %{w.op_name} carries "
+                        f"{w.result_str} but {role} %{name} parameter "
+                        f"is {sig.param0_str}",
+                        file=pm.file, line=w.line,
                     )
-                if role == "body" and sub.ops and \
-                        _shape_key(sub.root.result) != want:
+                if role == "body" and sig.has_ops and \
+                        sig.root_key != w.want:
                     diags.emit(
                         "TL005",
-                        f"{module.name}: while %{op.name} carries "
-                        f"{op.result} but body %{name} returns "
-                        f"{sub.root.result}",
-                        file=pm.file, line=line,
+                        f"{module.name}: while %{w.op_name} carries "
+                        f"{w.result_str} but body %{name} returns "
+                        f"{sig.root_str}",
+                        file=pm.file, line=w.line,
                     )
-                if role == "condition" and sub.ops:
-                    r = sub.root.result
-                    if not (
-                        isinstance(r, TensorSpec)
-                        and r.dtype == "pred" and r.shape == ()
-                    ):
-                        diags.emit(
-                            "TL005",
-                            f"{module.name}: while %{op.name} "
-                            f"condition %{name} returns {r} "
-                            f"(expected pred[])",
-                            file=pm.file, line=line,
-                        )
+                if role == "condition" and sig.has_ops and \
+                        not sig.root_is_scalar_pred:
+                    diags.emit(
+                        "TL005",
+                        f"{module.name}: while %{w.op_name} "
+                        f"condition %{name} returns {sig.root_str} "
+                        f"(expected pred[])",
+                        file=pm.file, line=w.line,
+                    )
+        pm.dataflow = self.builder.finish(module.entry_name)
 
 
 def _check_groups(
@@ -441,58 +720,6 @@ def _check_groups(
                 f"exactly)",
                 file=file, line=line,
             )
-
-
-def _check_collectives(pm: ParsedModule, diags: Diagnostics) -> None:
-    """TL008 byte-count consistency + TL009/TL014 on module collectives."""
-    module = pm.module
-    for comp in module.computations.values():
-        pos = {op.name: i for i, op in enumerate(comp.ops)}
-        for i, op in enumerate(comp.ops):
-            base = base_opcode(op.opcode)
-            if base not in COLLECTIVE_OPCODES or op.collective is None:
-                continue
-            line = pm.op_lines.get((comp.name, op.name))
-            ci = op.collective
-            _check_groups(
-                ci.replica_groups, module.num_devices,
-                f"{module.name}/{comp.name}: {op.opcode} %{op.name}",
-                diags, pm.file, line,
-            )
-            # byte-count relation: sync ops with resolvable operands only
-            # (async -start results interpose buffer tuples; variadic
-            # forms compare the summed element counts)
-            if op.is_async_start or op.is_async_done:
-                continue
-            in_elems = 0.0
-            ok = bool(op.operands)
-            for operand in op.operands:
-                j = pos.get(operand)
-                if j is None or j >= i:
-                    ok = False
-                    break
-                in_elems += comp.ops[j].result.elems
-            if not ok:
-                continue
-            out_elems = float(op.result.elems)
-            gs = ci.group_size if ci.replica_groups else None
-            expect: float | None = None
-            if base == "all-reduce":
-                expect = in_elems
-            elif base == "all-gather" and gs:
-                expect = in_elems * gs
-            elif base == "reduce-scatter" and gs:
-                expect = in_elems / gs
-            if expect is not None and out_elems != expect:
-                diags.emit(
-                    "TL008",
-                    f"{module.name}/{comp.name}: {base} %{op.name} "
-                    f"result has {out_elems:g} elements; operands "
-                    f"({in_elems:g} elements"
-                    + (f", group size {gs}" if gs else "")
-                    + f") imply {expect:g}",
-                    file=pm.file, line=line,
-                )
 
 
 def _check_commands(pt: ParsedTrace, diags: Diagnostics) -> None:
@@ -581,7 +808,7 @@ def run_trace_passes(
     TL012 escalates to error severity when ``lenient`` is False; a
     lenient replay skips the line with a counted warning, and the
     diagnostic stays at its registry (warning) severity."""
-    from tpusim.analysis.diagnostics import Severity
+    from tpusim.analysis.collective_passes import run_collective_matching
 
     if pt.meta_error is not None:
         diags.emit("TL010", pt.meta_error, file="meta.json", line=1)
@@ -591,34 +818,41 @@ def run_trace_passes(
         if err is None and rec.get("kind") == "kernel_launch"
     }
     for key, pm in sorted(pt.modules.items()):
-        if pm.module.entry_name is None and (
-            key in launched or not pt.has_commandlist
-        ):
-            diags.emit(
-                "TL011",
-                f"module {pm.module.name!r} has no ENTRY computation — "
-                f"the engine cannot replay it",
-                file=pm.file,
-                line=min(pm.comp_lines.values(), default=1),
-            )
-        for lineno, err in pm.skipped:
-            if lenient:
-                diags.emit(
-                    "TL012",
-                    f"malformed HLO line (the lenient parse skips it): "
-                    f"{err}",
-                    file=pm.file, line=lineno,
-                )
-            else:
-                diags.emit(
-                    "TL012",
-                    f"malformed HLO line (the strict parse the replay "
-                    f"uses will REJECT this module; pass "
-                    f"--lenient-parse to salvage): {err}",
-                    file=pm.file, line=lineno,
-                    severity=Severity.ERROR,
-                )
-        _check_dataflow(pm, diags)
-        _check_while(pm, diags)
-        _check_collectives(pm, diags)
+        run_module_passes(
+            pm, diags, lenient=lenient,
+            check_entry=key in launched or not pt.has_commandlist,
+        )
     _check_commands(pt, diags)
+    run_collective_matching(pt, diags)
+
+
+def run_module_passes(
+    pm: ParsedModule, diags: Diagnostics, lenient: bool = True,
+    check_entry: bool = True,
+) -> None:
+    """Every module-family pass over one module, one computation at a
+    time (the serving tier lints inline HLO through this entry point;
+    the streaming path never materializes the module)."""
+    from tpusim.analysis.diagnostics import Severity
+
+    checks = _ModuleChecks(pm, diags)
+    for comp, _header_line, op_lines in pm.iter_computations():
+        checks.feed(comp, op_lines)
+    for lineno, err in pm.skipped:
+        if lenient:
+            diags.emit(
+                "TL012",
+                f"malformed HLO line (the lenient parse skips it): "
+                f"{err}",
+                file=pm.file, line=lineno,
+            )
+        else:
+            diags.emit(
+                "TL012",
+                f"malformed HLO line (the strict parse the replay "
+                f"uses will REJECT this module; pass "
+                f"--lenient-parse to salvage): {err}",
+                file=pm.file, line=lineno,
+                severity=Severity.ERROR,
+            )
+    checks.finish(check_entry=check_entry)

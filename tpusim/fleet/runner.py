@@ -778,6 +778,8 @@ def run_fleet(
         report_path = out_dir / "report.json"
         tmp = report_path.with_suffix(f".tmp.{os.getpid()}")
         tmp.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        # lint-allow: TL352 derived artifact — the fsync'd journal is
+        # the durable record; a torn report rebuilds from it on resume
         os.replace(tmp, report_path)
     return FleetResult(
         doc=doc, stats=stats, out_dir=out_dir, report_path=report_path,

@@ -630,6 +630,7 @@ class ServeDaemon:
         compile_cache=None,
         hot_cache=None,
         hot_quota_bytes=None,
+        strict_lint: bool = False,
         acceptor_index: int | None = None,
         acceptors_total: int = 0,
         reuse_port: bool = False,
@@ -706,8 +707,14 @@ class ServeDaemon:
                 quota_bytes=self.cache_quota_bytes,
             )
         self.registry = TraceRegistry(trace_root)
+        # --strict-lint: every simulate request passes the trace-level
+        # lint gate first — errors OR warnings refuse with 422 + the
+        # diagnostics doc, verdict cached by content hash so the fleet
+        # lints each distinct trace once
+        self.strict_lint = bool(strict_lint)
         self.worker = ServeWorker(
             self.registry, result_cache=self.result_cache, workers=workers,
+            strict_lint=self.strict_lint,
         )
         # serve v3: the shared mmap hot-response cache.  Keyed by the
         # supervisor's content-hash affinity identity + a per-trace
@@ -759,6 +766,7 @@ class ServeDaemon:
                         if self.compile_store is not None else None
                     ),
                     "chaos_hooks": bool(chaos_hooks),
+                    "strict_lint": self.strict_lint,
                     # lets workers serialize the FINAL response body
                     # (byte-identical to _send_json's by construction)
                     "format_version": SERVE_FORMAT_VERSION,

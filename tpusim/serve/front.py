@@ -129,6 +129,7 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
         compile_cache=settings.get("compile_cache"),
         hot_cache=settings.get("hot_cache"),
         hot_quota_bytes=settings.get("hot_quota_bytes"),
+        strict_lint=settings.get("strict_lint", False),
         acceptor_index=index,
         acceptors_total=settings.get("acceptors_total", 0),
         reuse_port=not fd_mode and bool(settings.get("reuse_port", True)),

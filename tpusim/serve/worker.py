@@ -50,6 +50,11 @@ MAX_DEADLINE_S = 600.0
 #: not grow the daemon without bound; mirrors MAX_INLINE_ENTRIES)
 MAX_CONFIG_ENTRIES = 128
 
+#: strict-lint verdict cache bound (one full diagnostics doc per
+#: distinct trace content hash — refusal docs are small; clean docs
+#: are near-empty)
+MAX_LINT_VERDICTS = 512
+
 
 class RequestError(Exception):
     """A request-level failure with an HTTP status and a stable code.
@@ -115,13 +120,22 @@ class ServeWorker:
         registry,
         result_cache: ResultCache | None = None,
         workers: int = 1,
+        strict_lint: bool = False,
     ):
         self.registry = registry
         self.result_cache = result_cache
         self.workers = max(int(workers), 1)
+        self.strict_lint = bool(strict_lint)
         self.model_version = model_version()
         self._config_cache: dict[str, object] = {}
         self._config_lock = threading.Lock()
+        # strict-lint verdict tier: the full trace-pass diagnostics doc
+        # per CONTENT HASH — a fleet behind --strict-lint lints each
+        # distinct trace exactly once, then refuses (422) or admits
+        # from the cached verdict
+        self._lint_verdicts: dict[str, dict] = {}
+        self._lint_lock = threading.Lock()
+        self.strict_lint_refused = 0
         # requests priced by THIS worker object (the serve v3 front
         # smoke's zero-dispatch proof: a pass served entirely from the
         # hot mmap tier must leave this counter untouched)
@@ -213,9 +227,10 @@ class ServeWorker:
 
     def _analyze(self, entry, inline: bool, cfg, req: dict):
         """The per-request pre-flight: cached trace passes + fresh
-        config/schedule passes.  Returns the Diagnostics."""
+        config/schedule/memory passes.  Returns the Diagnostics."""
         from tpusim.analysis.config_passes import run_config_passes
         from tpusim.analysis.diagnostics import Diagnostics
+        from tpusim.analysis.memory_passes import run_memory_passes
 
         diags = Diagnostics()
         if not inline:
@@ -223,6 +238,9 @@ class ServeWorker:
                 self.registry.trace_diagnostics(entry).items
             )
         run_config_passes(cfg, diags, trace_meta=entry.pod.meta)
+        # TL40x vs the request's composed arch — the dataflow walk is
+        # memoized on each module object, so a hot pod pays it once
+        run_memory_passes(entry.pod.modules, cfg, diags)
         faults = req.get("faults")
         if faults is not None:
             from tpusim.analysis.schedule_passes import run_schedule_passes
@@ -243,6 +261,96 @@ class ServeWorker:
             },
         )
 
+    # -- strict-lint gate ----------------------------------------------------
+
+    def _content_key(self, entry, inline: bool, req: dict) -> str:
+        """The verdict-cache identity: the modules' content hashes
+        plus (registry traces) a commandlist fingerprint — the trace
+        passes judge BOTH artifacts, so two traces sharing modules but
+        differing commandlists must not cross-serve each other's
+        verdict.  The same content re-registered under another name,
+        or re-submitted inline, still lints once."""
+        hashes = sorted(
+            str(m.meta.get("content_hash", "") or "")
+            for m in entry.pod.modules.values()
+        )
+        if not hashes or not any(hashes):
+            hashes = [entry.name]  # degenerate: no stamped hash
+        if not inline:
+            # the trace passes judge THREE artifacts: modules,
+            # commandlist.jsonl, and meta.json (TL007/TL010 gate on
+            # the meta pod declaration) — the key must cover all of
+            # them or look-alike traces cross-serve verdicts
+            fp = getattr(entry, "_artifact_fp", None)
+            if fp is None:
+                import hashlib
+
+                parts = []
+                root = getattr(self.registry, "trace_root", None)
+                if root is not None:
+                    for fname in ("commandlist.jsonl", "meta.json"):
+                        p = root / entry.name / fname
+                        try:
+                            digest = hashlib.sha256(
+                                p.read_bytes()
+                            ).hexdigest()[:16]
+                        except OSError:
+                            digest = "absent"
+                        parts.append(f"{fname}:{digest}")
+                fp = ";".join(parts) or "no-root"
+                try:
+                    entry._artifact_fp = fp
+                except (AttributeError, TypeError):
+                    pass
+            hashes.append(fp)
+        return "|".join(hashes)
+
+    def _strict_lint_gate(self, entry, inline: bool, req: dict) -> None:
+        """``--strict-lint``: refuse (422 + the full diagnostics doc)
+        any trace whose trace-family passes report errors OR warnings.
+        The verdict is cached by content hash, so a fleet lints each
+        distinct trace once; later submissions are admitted or refused
+        from the cache without re-walking a line."""
+        key = self._content_key(entry, inline, req)
+        with self._lint_lock:
+            doc = self._lint_verdicts.get(key)
+        if doc is None:
+            from tpusim.analysis.diagnostics import Diagnostics
+
+            if inline:
+                from tpusim.analysis.trace_passes import (
+                    _parse_module_lines, run_module_passes,
+                )
+
+                diags = Diagnostics()
+                pm = _parse_module_lines(
+                    entry.name, "<inline hlo>",
+                    str(req.get("hlo_text", "")),
+                )
+                run_module_passes(pm, diags, lenient=True)
+            else:
+                diags = self.registry.trace_diagnostics(entry)
+            doc = json.loads(diags.to_json())
+            with self._lint_lock:
+                self._lint_verdicts.setdefault(key, doc)
+                while len(self._lint_verdicts) > MAX_LINT_VERDICTS:
+                    oldest = next(iter(self._lint_verdicts))
+                    if oldest == key:
+                        break
+                    self._lint_verdicts.pop(oldest)
+        counts = doc.get("counts", {})
+        if counts.get("error") or counts.get("warning"):
+            with self._lint_lock:
+                self.strict_lint_refused += 1
+            raise RequestError(
+                422, "strict_lint_refused",
+                f"strict lint refused the trace: "
+                f"{counts.get('error', 0)} error(s), "
+                f"{counts.get('warning', 0)} warning(s) "
+                f"(the daemon runs --strict-lint; see 'diagnostics')",
+                extra={"diagnostics": doc},
+            )
+
     # -- endpoints -----------------------------------------------------------
 
     def simulate(self, req: dict, cancel=None) -> dict:
@@ -256,6 +364,8 @@ class ServeWorker:
 
         entry, inline = self._resolve_entry(req)
         cfg = self._config_for(entry.pod, req)
+        if self.strict_lint:
+            self._strict_lint_gate(entry, inline, req)
         if bool(req.get("validate", True)):
             diags = self._analyze(entry, inline, cfg, req)
             if diags.has_errors:
@@ -536,6 +646,10 @@ class ServeWorker:
         with self._config_lock:
             out["configs_hot"] = len(self._config_cache)
         out["priced_total"] = self.priced
+        if self.strict_lint:
+            with self._lint_lock:
+                out["lint_verdicts_cached"] = len(self._lint_verdicts)
+            out["strict_lint_refused_total"] = self.strict_lint_refused
         with self._job_lock:
             out.update(self._job_totals)
         return out
@@ -642,7 +756,10 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
         # are idempotent across the fleet by design)
         quota_bytes=settings.get("cache_quota_bytes"),
     )
-    worker = ServeWorker(registry, result_cache=cache, workers=1)
+    worker = ServeWorker(
+        registry, result_cache=cache, workers=1,
+        strict_lint=bool(settings.get("strict_lint")),
+    )
     chaos = bool(settings.get("chaos_hooks"))
     # the daemon's response format version: when present, success
     # responses travel as the final serialized body bytes (see below)
