@@ -1863,6 +1863,246 @@ def front_smoke(serve_workers: int = 1) -> dict:
     }
 
 
+def reqtrace_smoke() -> dict:
+    """Request-tracing contract (tpusim.obs.reqtrace, L24) over a
+    2-acceptor front:
+
+    1. **off is zero-overhead**: a tracing-off fleet answers the golden
+       matrix byte-identical to the committed CLI goldens, stamps no
+       ``X-Tpusim-Trace`` header, exposes no ``tpusim_reqtrace_*``
+       series, and 404s the debug routes;
+    2. **on never touches a body**: with ``--trace-requests`` the same
+       matrix stays byte-identical to the goldens while every response
+       carries a well-formed trace ID (an inbound pinned ID echoed
+       verbatim);
+    3. **histograms account for every request**: the fleet ``/metrics``
+       view renders real ``# TYPE ... histogram`` series whose per-route
+       ``+Inf`` bucket counts sum EXACTLY to
+       ``tpusim_serve_requests_total``;
+    4. **the flight recorder answers**: the slowest recorded trace is
+       fetched by ID through whichever acceptor the client lands on
+       (fleet fan-out), its top-level phase spans sum within the
+       recorded total, and the ``?format=chrome`` export parses as a
+       Perfetto/Chrome ``traceEvents`` document;
+    5. **the access log lands**: per-acceptor JSONL files parse with
+       route/status/latency/trace-id fields.
+    Raises on violation."""
+    import json as _json
+    import tempfile
+
+    from tpusim.obs.reqtrace import TRACE_HEADER, valid_trace_id
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.front import FrontSupervisor
+
+    def matrix_pass(client) -> tuple[list[str], list[str | None]]:
+        """Serve the golden matrix via raw calls (the typed client
+        hides headers); returns (served canonical docs, trace ids)."""
+        served, tids = [], []
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            body = {"trace": fixture, "arch": arch, "tuned": False,
+                    "validate": True}
+            if overlays:
+                body["overlays"] = list(overlays)
+            resp, payload = client._raw(
+                "POST", "/v1/simulate", body, idempotent=True,
+            )
+            if resp.status != 200:
+                raise ValueError(
+                    f"reqtrace smoke: {name} answered {resp.status}"
+                )
+            stats = _json.loads(payload)["stats"]
+            if _serve_served_bytes(stats) != _serve_golden_bytes(name):
+                raise ValueError(
+                    f"reqtrace smoke: served stats for {name} diverged "
+                    f"from the committed CLI golden"
+                )
+            served.append(name)
+            tids.append(resp.getheader(TRACE_HEADER))
+        return served, tids
+
+    # -- pass 1: tracing off (the default) ---------------------------------
+    front = FrontSupervisor(
+        settings={"trace_root": str(FIXTURES), "max_inflight": 4},
+        num_acceptors=2,
+    ).start()
+    try:
+        client = ServeClient(front.url, retries=3)
+        _, tids = matrix_pass(client)
+        stamped = [t for t in tids if t is not None]
+        if stamped:
+            raise ValueError(
+                f"reqtrace smoke: tracing-off responses carried trace "
+                f"headers: {stamped}"
+            )
+        text = client.metrics_text()
+        if "tpusim_reqtrace" in text:
+            raise ValueError(
+                "reqtrace smoke: tracing-off /metrics grew reqtrace "
+                "series"
+            )
+        resp, _ = client._raw("GET", "/v1/debug/traces")
+        if resp.status != 404:
+            raise ValueError(
+                f"reqtrace smoke: tracing-off debug route answered "
+                f"{resp.status}, expected 404"
+            )
+    finally:
+        if not front.stop():
+            raise ValueError(
+                "reqtrace smoke: tracing-off fleet did not drain"
+            )
+
+    # -- pass 2: tracing on -------------------------------------------------
+    with tempfile.TemporaryDirectory(prefix="tpusim_reqtrace_") as td:
+        front = FrontSupervisor(
+            settings={
+                "trace_root": str(FIXTURES), "max_inflight": 4,
+                "trace_requests": True,
+                "access_log": f"{td}/access.jsonl",
+            },
+            num_acceptors=2,
+        ).start()
+        try:
+            client = ServeClient(front.url, retries=3)
+            _, tids = matrix_pass(client)
+            bad = [t for t in tids if not (t and valid_trace_id(t))]
+            if bad:
+                raise ValueError(
+                    f"reqtrace smoke: malformed/missing trace ids: {bad}"
+                )
+
+            # an inbound pinned ID must be echoed verbatim
+            import http.client as _http
+
+            conn = _http.HTTPConnection(front.host, front.port,
+                                        timeout=30)
+            try:
+                conn.request(
+                    "POST", "/v1/simulate",
+                    body=_json.dumps({
+                        "trace": MATRIX[0][0], "arch": MATRIX[0][1],
+                        "tuned": False, "validate": True,
+                    }).encode(),
+                    headers={"Content-Type": "application/json",
+                             TRACE_HEADER: "deadbeef01234567"},
+                )
+                resp = conn.getresponse()
+                resp.read()
+                echoed = resp.getheader(TRACE_HEADER)
+            finally:
+                conn.close()
+            if echoed != "deadbeef01234567":
+                raise ValueError(
+                    f"reqtrace smoke: pinned inbound trace id came "
+                    f"back as {echoed!r}"
+                )
+
+            # fleet histogram accounting: +Inf bucket counts == counter
+            text = client.metrics_text()
+            if "# TYPE tpusim_reqtrace_route_ms histogram" not in text:
+                raise ValueError(
+                    "reqtrace smoke: /metrics lacks the route "
+                    "histogram TYPE line"
+                )
+            bucket_total = sum(
+                float(ln.split()[1]) for ln in text.splitlines()
+                if ln.startswith("tpusim_reqtrace_route_ms_bucket")
+                and 'le="+Inf"' in ln
+            )
+            counter = next(
+                (float(ln.split()[1]) for ln in text.splitlines()
+                 if ln.startswith("tpusim_serve_requests_total ")),
+                None,
+            )
+            if counter is None or bucket_total != counter:
+                raise ValueError(
+                    f"reqtrace smoke: histogram buckets account for "
+                    f"{bucket_total} requests, counter says {counter}"
+                )
+            for ln in text.splitlines():
+                if ln.startswith("#") or not ln.strip():
+                    continue
+                parts = ln.split()
+                if len(parts) != 2:
+                    raise ValueError(
+                        f"reqtrace smoke: unparseable sample line "
+                        f"{ln!r}"
+                    )
+                float(parts[1])
+
+            # the slowest recorded trace, fetched by ID fleet-wide
+            recent = client.recent_traces()
+            if not recent:
+                raise ValueError(
+                    "reqtrace smoke: flight recorder is empty after "
+                    "the matrix"
+                )
+            slowest = recent[0]["trace_id"]
+            doc = client.trace_detail(slowest)
+            if doc.get("trace_id") != slowest:
+                raise ValueError(
+                    f"reqtrace smoke: trace {slowest} not retrievable "
+                    f"by id"
+                )
+            top_ms = sum(
+                s["dur_ms"] for s in doc["spans"]
+                if "/" not in s["path"]
+            )
+            if top_ms > doc["total_ms"] + 0.5:
+                raise ValueError(
+                    f"reqtrace smoke: top-level spans sum to "
+                    f"{top_ms:.3f}ms, exceeding the recorded total "
+                    f"{doc['total_ms']:.3f}ms"
+                )
+            chrome = client.trace_detail(slowest, chrome=True)
+            events = chrome.get("traceEvents")
+            if not events or not any(
+                e.get("ph") == "X" for e in events
+            ):
+                raise ValueError(
+                    "reqtrace smoke: chrome export lacks duration "
+                    "events"
+                )
+
+            n_traced = len(tids) + 1
+        finally:
+            if not front.stop():
+                raise ValueError(
+                    "reqtrace smoke: tracing-on fleet did not drain"
+                )
+
+        # access log: per-acceptor JSONL files with the full field set
+        log_lines = 0
+        log_files = sorted(Path(td).glob("access*.jsonl*"))
+        for p in log_files:
+            for ln in p.read_text().splitlines():
+                rec = _json.loads(ln)
+                if not {"route", "status", "latency_ms", "trace_id",
+                        "ts_s"} <= set(rec):
+                    raise ValueError(
+                        f"reqtrace smoke: access-log record missing "
+                        f"fields: {rec}"
+                    )
+                log_lines += 1
+        if log_lines < n_traced:
+            raise ValueError(
+                f"reqtrace smoke: access logs hold {log_lines} lines "
+                f"for {n_traced}+ served requests"
+            )
+
+    return {
+        "configs": len(MATRIX),
+        "traced": n_traced,
+        "bucket_total": bucket_total,
+        "access_log_lines": log_lines,
+        "access_log_files": len(log_files),
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -1919,6 +2159,16 @@ def main(argv: list[str] | None = None) -> int:
                          "costing zero failed requests, and guard "
                          "deadline-504 / shared-quarantine semantics "
                          "holding across acceptors")
+    ap.add_argument("--reqtrace-smoke", action="store_true",
+                    help="request-tracing contract over a 2-acceptor "
+                         "front: tracing off = byte-identical goldens "
+                         "with zero new surface; tracing on = the same "
+                         "bytes plus X-Tpusim-Trace on every response, "
+                         "fleet /metrics histograms whose bucket "
+                         "counts sum to serve_requests_total, the "
+                         "slowest trace fetched by id with a valid "
+                         "Perfetto export, and parseable per-acceptor "
+                         "JSONL access logs")
     ap.add_argument("--advise-smoke", action="store_true",
                     help="run the fixed-spec sharding-advisor sweep on "
                          "the llama_tiny fixture: the ranked report "
@@ -1959,6 +2209,23 @@ def main(argv: list[str] | None = None) -> int:
                          "and the healthy golden matrix must be "
                          "untouched")
     args = ap.parse_args(argv)
+
+    if args.reqtrace_smoke:
+        try:
+            summary = reqtrace_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --reqtrace-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --reqtrace-smoke: OK "
+              f"({summary['configs']} configs byte-identical to the "
+              f"goldens with tracing off AND on, "
+              f"{summary['traced']} traced requests, fleet histogram "
+              f"buckets account for {summary['bucket_total']:.0f} "
+              f"requests exactly, slowest trace fetched by id with a "
+              f"valid chrome export, {summary['access_log_lines']} "
+              f"access-log lines across "
+              f"{summary['access_log_files']} per-acceptor files)")
+        return 0
 
     if args.dataflow_smoke:
         try:

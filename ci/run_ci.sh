@@ -65,27 +65,37 @@
 #                mid-matrix costing zero failed requests, and guard
 #                deadline-504 / shared-quarantine semantics holding
 #                across acceptors
-#  13. campaign — campaign-layer determinism: a fixed-seed 16-scenario
+#  13. reqtrace — request-tracing contract (tpusim.obs.reqtrace):
+#                tracing off = golden matrix byte-identical with zero
+#                new surface (no trace header, no reqtrace series,
+#                debug routes 404); tracing on over a 2-acceptor
+#                front = same bytes plus X-Tpusim-Trace on every
+#                response, fleet /metrics histograms whose +Inf
+#                bucket counts sum exactly to serve_requests_total,
+#                the slowest recorded trace fetched by id (fleet
+#                fan-out) with a valid Perfetto/Chrome export, and
+#                parseable per-acceptor JSONL access logs
+#  14. campaign — campaign-layer determinism: a fixed-seed 16-scenario
 #                Monte-Carlo compound-fault campaign on the llama_tiny
 #                fixture must reproduce the committed report
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  14. advise  — sharding-advisor determinism: a fixed-spec strategy
+#  15. advise  — sharding-advisor determinism: a fixed-spec strategy
 #                sweep on the llama_tiny fixture must reproduce the
 #                committed ranked report byte-for-byte (step-time/
 #                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  15. guard   — resource-governance contract (tpusim.guard): the
+#  16. guard   — resource-governance contract (tpusim.guard): the
 #                golden matrix under a small --cache-quota stays
 #                byte-identical while the cache dir never exceeds the
 #                quota (LRU GC provably engaged), and a served request
 #                past its deadline 504s through cooperative in-process
 #                cancellation with the worker still alive (zero
 #                restarts/kills, warm caches serving the next request)
-#  16. fleet   — fleet digital-twin determinism (tpusim.fleet): a
+#  17. fleet   — fleet digital-twin determinism (tpusim.fleet): a
 #                fixed-seed traffic-driven fleet simulation on the
 #                llama_tiny fixture must reproduce the committed
 #                report byte-for-byte (goodput/p99 curve, per-policy
@@ -93,7 +103,7 @@
 #                loss with its elastic-recovery row, a non-null
 #                capacity-frontier answer), with the healthy golden
 #                matrix untouched
-#  17. dataflow — tpusim.analysis v2 contract: committed fixtures +
+#  18. dataflow — tpusim.analysis v2 contract: committed fixtures +
 #                golden-matrix traces lint clean of TL4xx/TL41x
 #                errors, the liveness pass agrees byte-for-byte with
 #                the engine's residency walk across the fixture +
@@ -101,15 +111,15 @@
 #                mismatched-collective trace is statically refused,
 #                and the TL35x determinism/durability self-audit over
 #                tpusim/'s own sources is green
-#  18. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  19. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-17
+# Usage:  bash ci/run_ci.sh            # tiers 1-18
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/18] build native from source (+ native parity suite) ==="
+echo "=== [1/19] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -123,7 +133,7 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/18] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/19] repo static analysis (ruff / stdlib fallback) ==="
 lint_rc=0
 python ci/lint_repo.py --json > /tmp/tpusim_lint_repo.json || lint_rc=$?
 python - <<'PYEOF'
@@ -135,56 +145,59 @@ for f in doc["findings"]:
 PYEOF
 [[ "$lint_rc" == "0" ]] || exit "$lint_rc"
 
-echo "=== [3/18] unit tests (fast tier) ==="
+echo "=== [3/19] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/18] golden-stat regression sims ==="
+echo "=== [4/19] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/18] obs export smoke (schema-checked) ==="
+echo "=== [5/19] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/18] faults smoke (degraded-pod contract) ==="
+echo "=== [6/19] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/18] trace/config/schedule lint smoke ==="
+echo "=== [7/19] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/18] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/19] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/18] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
+echo "=== [9/19] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/18] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/19] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/18] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/19] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/18] front smoke (serve v3 multi-acceptor contract) ==="
+echo "=== [12/19] front smoke (serve v3 multi-acceptor contract) ==="
 python ci/check_golden.py --front-smoke
 
-echo "=== [13/18] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [13/19] reqtrace smoke (request-tracing + latency-histogram contract) ==="
+python ci/check_golden.py --reqtrace-smoke
+
+echo "=== [14/19] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [14/18] advise smoke (sharding-advisor determinism) ==="
+echo "=== [15/19] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [15/18] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [16/19] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
-echo "=== [16/18] fleet smoke (digital-twin determinism) ==="
+echo "=== [17/19] fleet smoke (digital-twin determinism) ==="
 python ci/check_golden.py --fleet-smoke
 
-echo "=== [17/18] dataflow smoke (liveness/deadlock/self-audit contract) ==="
+echo "=== [18/19] dataflow smoke (liveness/deadlock/self-audit contract) ==="
 python ci/check_golden.py --dataflow-smoke
 
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [18/18] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [19/19] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [18/18] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [19/19] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

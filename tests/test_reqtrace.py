@@ -1,0 +1,501 @@
+"""Request-scoped tracing (tpusim.obs.reqtrace, L24): span-tree unit
+math, tail-sampling policy, histogram exposition, and the live-daemon
+contract both ways:
+
+* tracing OFF (the default) is zero-overhead — no recorder allocated,
+  no new stats keys, no response header, debug routes 404, and the
+  volatile-stripped response bytes match a tracing-on daemon exactly;
+* tracing ON grows only ``/metrics`` (real ``_bucket``/``_sum``/
+  ``_count`` histogram series whose +Inf counts sum to
+  ``serve_requests_total``), the ``/v1/debug/traces`` routes, and the
+  ``X-Tpusim-Trace`` response header.
+
+Also pins the prometheus TYPE contract: ``*_total`` keys are counters,
+everything else a gauge, one TYPE line per name.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tpusim.obs.export import prometheus_text, request_chrome_trace
+from tpusim.obs.reqtrace import (
+    BUCKET_BOUNDS_MS,
+    TRACE_HEADER,
+    AccessLog,
+    FlightRecorder,
+    LatencyHistogram,
+    RequestTracer,
+    histogram_exposition,
+    mint_trace_id,
+    valid_trace_id,
+)
+from tpusim.serve.client import ServeClient
+from tpusim.serve.daemon import ServeDaemon
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "traces"
+
+#: the serve test suite's canonicalization: host-dependent values and
+#: the perf layer's own accounting never take part in byte equality
+VOLATILE = {"simulation_rate_kops", "wall_seconds", "silicon_slowdown"}
+PERF_PREFIXES = ("cache_", "pool_")
+
+SIM_BODY = {
+    "trace": "matmul_512", "arch": "v5p", "tuned": True, "validate": True,
+}
+
+
+def canonical(payload: bytes) -> str:
+    doc = json.loads(payload)
+    doc["stats"] = {
+        k: v for k, v in doc["stats"].items()
+        if k not in VOLATILE and not k.startswith(PERF_PREFIXES)
+    }
+    return json.dumps(doc, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+
+def test_mint_trace_id_random_and_wellformed():
+    a, b = mint_trace_id(), mint_trace_id()
+    assert a != b
+    assert valid_trace_id(a) and valid_trace_id(b)
+    assert len(a) == 16
+
+
+def test_mint_trace_id_honors_wellformed_inbound():
+    assert mint_trace_id("deadbeef01234567") == "deadbeef01234567"
+    # normalized, not rejected
+    assert mint_trace_id("  DEADBEEF01234567 ") == "deadbeef01234567"
+
+
+@pytest.mark.parametrize("bad", [
+    "", "xyz", "short", "deadbeef0123456g", "a" * 33,
+    "../../etc/passwd", "deadbeef 0123",
+])
+def test_mint_trace_id_rejects_malformed_inbound(bad):
+    tok = mint_trace_id(bad)
+    assert tok != bad.strip().lower()
+    assert valid_trace_id(tok)
+    assert not valid_trace_id(bad)
+
+
+# ---------------------------------------------------------------------------
+# span tree
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_builds_slash_paths():
+    rt = RequestTracer(acceptor_index=3)
+    tr = rt.begin("simulate", None)
+    with tr.span("dispatch"):
+        with tr.span("price"):
+            pass
+        with tr.span("serialize"):
+            pass
+    doc = rt.finish(tr, 200)
+    paths = [s["path"] for s in doc["spans"]]
+    assert paths == ["dispatch", "dispatch/price", "dispatch/serialize"]
+    assert doc["acceptor"] == 3
+    # children start no earlier than the parent and fit inside it
+    parent = next(s for s in doc["spans"] if s["path"] == "dispatch")
+    for s in doc["spans"]:
+        if s["path"].startswith("dispatch/"):
+            assert s["start_ms"] >= parent["start_ms"]
+            assert s["start_ms"] + s["dur_ms"] <= (
+                parent["start_ms"] + parent["dur_ms"] + 1e-3
+            )
+
+
+def test_worker_spans_merge_under_dispatch_and_tolerate_garbage():
+    rt = RequestTracer()
+    tr = rt.begin("simulate", None)
+    t0 = time.monotonic()
+    tr.add_worker_spans(
+        [("price", t0, 0.001), ("serialize", t0, 0.0005),
+         "garbage", ("short",), None],
+    )
+    doc = rt.finish(tr, 200)
+    assert [s["path"] for s in doc["spans"]] == [
+        "dispatch/price", "dispatch/serialize",
+    ]
+
+
+def test_fd_dispatch_pulls_start_back_to_accept_instant():
+    rt = RequestTracer()
+    t_accept = time.monotonic()
+    time.sleep(0.002)
+    tr = rt.begin("simulate", None, start_s=time.monotonic())
+    tr.note_fd_dispatch(t_accept, time.monotonic())
+    doc = rt.finish(tr, 200)
+    fdd = next(s for s in doc["spans"] if s["path"] == "fd_dispatch")
+    assert fdd["start_ms"] == 0.0
+    assert fdd["dur_ms"] >= 1.0  # the slept handoff leg is visible
+
+
+def test_finish_is_idempotent():
+    rt = RequestTracer()
+    tr = rt.begin("metrics", None)
+    doc1 = rt.finish(tr, 200)
+    time.sleep(0.001)
+    doc2 = rt.finish(tr, 500)  # late second call changes nothing
+    assert doc2 is doc1
+    assert doc1["status"] == 200
+    # and the completion was observed exactly once
+    assert rt.metrics_values()["reqtrace_route_ms__metrics__cnt"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucketing_boundaries_and_overflow():
+    h = LatencyHistogram()
+    h.observe(0.25)      # on the first bound -> bucket 0 (le is <=)
+    h.observe(0.26)      # just past -> bucket 1
+    h.observe(1e9)       # overflow slot
+    h.observe(-5.0)      # clamped to 0 -> bucket 0
+    assert h.counts[0] == 2
+    assert h.counts[1] == 1
+    assert h.counts[-1] == 1
+    assert h.count == 4
+    assert h.sum_ms == pytest.approx(0.25 + 0.26 + 1e9)
+
+
+def test_histogram_exposition_renders_cumulative_series():
+    rt = RequestTracer()
+    for _ in range(3):
+        tr = rt.begin("simulate", None)
+        rt.finish(tr, 200)
+    values = rt.metrics_values()
+    rest, lines = histogram_exposition(values)
+    # histogram keys split out; counters flow through untouched
+    assert not any(k.startswith("reqtrace_route_ms") for k in rest)
+    assert "reqtrace_recorded_total" in rest
+    assert "# TYPE tpusim_reqtrace_route_ms histogram" in lines
+    bucket = [ln for ln in lines if ln.startswith(
+        'tpusim_reqtrace_route_ms_bucket{route="simulate"')]
+    assert len(bucket) == len(BUCKET_BOUNDS_MS) + 1  # + the +Inf line
+    counts = [float(ln.split()[1]) for ln in bucket]
+    assert counts == sorted(counts)  # cumulative is monotone
+    assert counts[-1] == 3.0         # +Inf == observation count
+    # every sample line splits into exactly two whitespace parts (the
+    # scrape validators' invariant): labels contain no spaces
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        parts = ln.split()
+        assert len(parts) == 2, ln
+        float(parts[1])
+
+
+def test_histogram_exposition_accepts_fleet_merged_floats():
+    # the fleet merge sums peer values into floats; exposition must
+    # render them without complaint
+    values = {
+        "reqtrace_route_ms__simulate__b0": 3.0,
+        "reqtrace_route_ms__simulate__b2": 1.0,
+        "reqtrace_route_ms__simulate__sum": 12.5,
+        "reqtrace_route_ms__simulate__cnt": 4.0,
+        "serve_requests_total": 4.0,
+    }
+    rest, lines = histogram_exposition(values)
+    assert rest == {"serve_requests_total": 4.0}
+    inf = next(ln for ln in lines if 'le="+Inf"' in ln)
+    assert float(inf.split()[1]) == 4.0
+    le1 = next(ln for ln in lines if 'le="4"' in ln)
+    assert float(le1.split()[1]) == 4.0  # 3 + 1 cumulative
+
+
+# ---------------------------------------------------------------------------
+# flight recorder tail-sampling
+# ---------------------------------------------------------------------------
+
+
+def _doc(tid, route="simulate", status=200, total_ms=1.0):
+    return {"trace_id": tid, "route": route, "status": status,
+            "total_ms": total_ms, "acceptor": None, "spans": []}
+
+
+def test_recorder_keeps_n_slowest_per_route():
+    rec = FlightRecorder(keep_slowest=3)
+    for i in range(10):
+        rec.record(_doc(f"{i:016x}", total_ms=float(i)))
+    kept = rec.snapshot()
+    assert [d["total_ms"] for d in kept] == [9.0, 8.0, 7.0]
+    # a faster trace never evicts a slower one
+    assert rec.record(_doc("f" * 16, total_ms=0.5)) is False
+    assert rec.sampled_out_total == 7 + 1
+    assert rec.live == 3
+
+
+def test_recorder_keeps_every_error_in_its_own_ring():
+    rec = FlightRecorder(keep_slowest=1, keep_errors=4)
+    rec.record(_doc("a" * 16, total_ms=99.0))
+    for i in range(6):
+        rec.record(_doc(f"e{i:015x}", status=504, total_ms=0.01))
+    kept = rec.snapshot()
+    # the slow success survives a flood of fast errors, and the error
+    # ring holds the most recent keep_errors of them
+    assert kept[0]["total_ms"] == 99.0
+    assert sum(1 for d in kept if d["status"] == 504) == 4
+    assert rec.get("e5" + "0" * 13 + "5") is None  # malformed id
+    assert rec.get("a" * 16)["total_ms"] == 99.0
+
+
+def test_recorder_bounds_route_cardinality():
+    rec = FlightRecorder(keep_slowest=2, max_routes=2)
+    assert rec.record(_doc("1" * 16, route="a"))
+    assert rec.record(_doc("2" * 16, route="b"))
+    assert rec.record(_doc("3" * 16, route="c")) is False
+    assert rec.sampled_out_total == 1
+
+
+# ---------------------------------------------------------------------------
+# prometheus TYPE contract
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_total_keys_are_counters():
+    text = prometheus_text({
+        "serve_requests_total": 5, "serve_uptime_s": 1.5, "ok": 1,
+    })
+    assert "# TYPE tpusim_serve_requests_total counter" in text
+    assert "# TYPE tpusim_serve_uptime_s gauge" in text
+    assert "# TYPE tpusim_ok gauge" in text
+
+
+def test_prometheus_one_type_line_per_name():
+    rt = RequestTracer()
+    tr = rt.begin("simulate", None)
+    rt.finish(tr, 200)
+    rest, lines = histogram_exposition(rt.metrics_values())
+    text = prometheus_text(rest) + "\n".join(lines) + "\n"
+    seen: dict[str, str] = {}
+    for ln in text.splitlines():
+        if not ln.startswith("# TYPE "):
+            continue
+        _, _, name, mtype = ln.split()
+        assert name not in seen, f"duplicate TYPE for {name}"
+        seen[name] = mtype
+    assert seen["tpusim_reqtrace_recorded_total"] == "counter"
+    assert seen["tpusim_reqtrace_traces_live"] == "gauge"
+    assert seen["tpusim_reqtrace_route_ms"] == "histogram"
+
+
+# ---------------------------------------------------------------------------
+# chrome export
+# ---------------------------------------------------------------------------
+
+
+def test_request_chrome_trace_shape():
+    rt = RequestTracer(acceptor_index=1)
+    tr = rt.begin("simulate", "deadbeefcafef00d")
+    with tr.span("dispatch"):
+        with tr.span("price"):
+            pass
+    doc = rt.finish(tr, 200)
+    ct = request_chrome_trace(doc)
+    assert ct["displayTimeUnit"] == "ms"
+    events = ct["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    # the request envelope plus one slice per span
+    assert len(xs) == 1 + len(doc["spans"])
+    assert all(e["dur"] > 0 for e in xs)
+    names = {e["name"] for e in events if e.get("ph") == "M"}
+    assert "process_name" in names and "thread_name" in names
+    json.dumps(ct)  # serializable as-is for Perfetto
+
+
+# ---------------------------------------------------------------------------
+# access log
+# ---------------------------------------------------------------------------
+
+
+def test_access_log_jsonl_fields_and_rotation(tmp_path):
+    path = tmp_path / "access.jsonl"
+    log = AccessLog(path, max_bytes=256)
+    for i in range(16):
+        log.write(route="simulate", status=200, latency_ms=1.25,
+                  trace_id="ab" * 8, tier="warm", acceptor=0)
+    log.close()
+    log.close()  # idempotent
+    assert path.exists()
+    rotated = path.with_name(path.name + ".1")
+    assert rotated.exists()  # 16 lines of ~100B crossed 256B repeatedly
+    recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+    recs += [json.loads(ln) for ln in rotated.read_text().splitlines()]
+    assert recs
+    r = recs[0]
+    assert set(r) == {
+        "ts_s", "trace_id", "route", "status", "latency_ms", "tier",
+        "acceptor",
+    }
+    assert r["status"] == 200 and r["tier"] == "warm"
+    assert log.lines_total == 16
+
+
+# ---------------------------------------------------------------------------
+# statskeys namespace
+# ---------------------------------------------------------------------------
+
+
+def test_statskeys_reqtrace_namespace_registered():
+    from tpusim.analysis.statskeys import AUDIT_GLOBS, STATS_NAMESPACES
+
+    assert "reqtrace_" in STATS_NAMESPACES
+    owners = STATS_NAMESPACES["reqtrace_"]
+    assert "tpusim/obs/" in owners
+    assert "tpusim/serve/" in owners
+    # the minting module is inside an audited glob, so the namespace is
+    # actually enforced, not merely declared
+    assert "tpusim/obs/*.py" in AUDIT_GLOBS
+
+
+# ---------------------------------------------------------------------------
+# live daemon: zero-overhead-off contract + tracing-on surfaces
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def off_daemon():
+    d = ServeDaemon(trace_root=str(FIXTURES), max_inflight=4).start()
+    yield d
+    d.drain_and_stop()
+
+
+#: lazy response matrix from the tracing-off daemon, issued from INSIDE
+#: a test (module-fixture setup would run before the conftest autouse
+#: TPUSIM_TUNED_DIR pin, composing a differently-tuned config)
+_OFF_PASS: dict = {}
+
+
+@pytest.fixture
+def off_pass(off_daemon):
+    if not _OFF_PASS:
+        c = ServeClient(off_daemon.url)
+        bodies = []
+        for _ in range(2):  # cold then warm
+            resp, payload = c._raw("POST", "/v1/simulate", SIM_BODY)
+            bodies.append((resp, payload))
+        _OFF_PASS["sim"] = bodies
+        _OFF_PASS["metrics"] = c.metrics_text()
+        _OFF_PASS["debug_status"] = \
+            c._raw("GET", "/v1/debug/traces")[0].status
+        _OFF_PASS["stats_keys"] = set(off_daemon.metrics_values())
+        _OFF_PASS["reqtrace_attr"] = off_daemon.reqtrace
+        _OFF_PASS["access_log_attr"] = off_daemon.access_log
+    return _OFF_PASS
+
+
+@pytest.fixture(scope="module")
+def on_daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("reqtrace_on")
+    d = ServeDaemon(
+        trace_root=str(FIXTURES), max_inflight=4,
+        trace_requests=True, access_log=str(tmp / "access.jsonl"),
+    ).start()
+    d._access_log_path = tmp / "access.jsonl"
+    yield d
+    d.drain_and_stop()
+
+
+def test_tracing_off_is_zero_overhead(off_pass):
+    assert off_pass["reqtrace_attr"] is None
+    assert off_pass["access_log_attr"] is None
+    assert not any(k.startswith("reqtrace_") for k in off_pass["stats_keys"])
+    assert "reqtrace" not in off_pass["metrics"]
+    assert off_pass["debug_status"] == 404
+    for resp, _ in off_pass["sim"]:
+        assert resp.getheader(TRACE_HEADER) is None
+
+
+def test_tracing_on_is_byte_identical_and_traced(off_pass, on_daemon):
+    c = ServeClient(on_daemon.url)
+    for resp_off, payload_off in off_pass["sim"]:
+        resp_on, payload_on = c._raw("POST", "/v1/simulate", SIM_BODY)
+        tid = resp_on.getheader(TRACE_HEADER)
+        assert tid and valid_trace_id(tid)
+        # the body never changes — only the header grows
+        assert canonical(payload_on) == canonical(payload_off)
+        assert set(json.loads(payload_on)["stats"]) == \
+            set(json.loads(payload_off)["stats"])
+    assert c.last_trace_id == tid
+
+    # the trace is retrievable and its top-level spans fit the total
+    doc = c.trace_detail(tid)
+    assert doc["trace_id"] == tid
+    paths = [s["path"] for s in doc["spans"]]
+    assert "dispatch" in paths and "dispatch/price" in paths
+    top = sum(s["dur_ms"] for s in doc["spans"] if "/" not in s["path"])
+    assert top <= doc["total_ms"] + 0.05
+    assert (doc.get("meta") or {}).get("tier") in ("warm", "priced")
+
+    # recent_traces lists it; chrome export loads
+    assert any(s["trace_id"] == tid for s in c.recent_traces())
+    assert "traceEvents" in c.trace_detail(tid, chrome=True)
+
+
+def test_tracing_on_inbound_header_is_honored(on_daemon):
+    import http.client
+
+    conn = http.client.HTTPConnection(
+        on_daemon.host, on_daemon.port, timeout=30,
+    )
+    try:
+        conn.request(
+            "POST", "/v1/simulate", body=json.dumps(SIM_BODY).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: "deadbeef01234567"},
+        )
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader(TRACE_HEADER) == "deadbeef01234567"
+    finally:
+        conn.close()
+
+
+def test_tracing_on_metrics_histograms_sum_to_request_counter(on_daemon):
+    c = ServeClient(on_daemon.url)
+    c.healthz()
+    text = c.metrics_text()
+    assert "# TYPE tpusim_reqtrace_route_ms histogram" in text
+    assert "# TYPE tpusim_serve_requests_total counter" in text
+    inf = [ln for ln in text.splitlines()
+           if ln.startswith("tpusim_reqtrace_route_ms_bucket")
+           and 'le="+Inf"' in ln]
+    bucket_total = sum(float(ln.split()[1]) for ln in inf)
+    counter = next(
+        float(ln.split()[1]) for ln in text.splitlines()
+        if ln.startswith("tpusim_serve_requests_total ")
+    )
+    # the /metrics request observes itself before rendering, so the
+    # equality is exact, not off-by-one
+    assert bucket_total == counter
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln.strip():
+            continue
+        parts = ln.split()
+        assert len(parts) == 2, ln
+        float(parts[1])
+
+
+def test_tracing_on_writes_access_log(on_daemon):
+    # run after the traffic-generating tests: flush happens on close,
+    # so read through the daemon's still-open handle state via a sync
+    on_daemon.access_log._fh.flush()
+    lines = on_daemon._access_log_path.read_text().splitlines()
+    assert lines
+    recs = [json.loads(ln) for ln in lines]
+    assert any(r["route"] == "simulate" and r["trace_id"] for r in recs)
+    assert all(
+        {"ts_s", "route", "status", "latency_ms"} <= set(r) for r in recs
+    )

@@ -222,7 +222,7 @@ def test_metrics_prometheus_parses(client):
     assert gauges["tpusim_serve_requests_total"] > 0
     assert "tpusim_serve_admission_inflight" in gauges
     assert "tpusim_serve_cache_hits" in gauges
-    assert "# TYPE tpusim_serve_requests_total gauge" in text
+    assert "# TYPE tpusim_serve_requests_total counter" in text
     assert "# HELP tpusim_serve_requests_total" in text
 
 

@@ -811,6 +811,8 @@ def _cmd_serve_front(args: argparse.Namespace) -> int:
         "compile_cache": args.compile_cache,
         "hot_cache": args.hot_cache,
         "strict_lint": args.strict_lint,
+        "trace_requests": args.trace_requests,
+        "access_log": args.access_log,
         "quarantine_dir": quarantine_dir,
     }
     front = FrontSupervisor(
@@ -873,6 +875,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             compile_cache=args.compile_cache,
             hot_cache=args.hot_cache,
             strict_lint=args.strict_lint,
+            trace_requests=args.trace_requests,
+            access_log=args.access_log,
         )
     except ValueError as e:
         # a quota/size typo must refuse loudly, not bound nothing
@@ -1824,6 +1828,20 @@ def main(argv: list[str] | None = None) -> int:
                           "passes report errors OR warnings; the "
                           "verdict is cached by content hash, so the "
                           "fleet lints each distinct trace once")
+    psv.add_argument("--trace-requests", action="store_true",
+                     help="request-scoped tracing: every response "
+                          "carries X-Tpusim-Trace, phase spans land in "
+                          "a bounded flight recorder (GET /v1/debug/"
+                          "traces), and /metrics grows per-route/per-"
+                          "phase latency histograms; off = zero "
+                          "overhead and byte-identical responses")
+    psv.add_argument("--access-log", nargs="?", const=True, default=None,
+                     metavar="PATH",
+                     help="structured JSONL access log (route, status, "
+                          "latency_ms, trace_id, cache tier, acceptor; "
+                          "default path <state-dir>/access.jsonl, "
+                          "size-rotated); independent of "
+                          "--trace-requests")
     psv.add_argument("--verbose", action="store_true",
                      help="per-request access log on stderr")
     psv.set_defaults(fn=_cmd_serve)

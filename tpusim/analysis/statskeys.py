@@ -127,6 +127,18 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/advise/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
+    # request-scoped tracing (L24): per-route/per-phase latency
+    # histogram state + flight-recorder counters, exported on /metrics
+    # ONLY when `--trace-requests` is active (the guard_* discipline:
+    # tracing off means zero reqtrace keys and byte-identical
+    # responses).  Key literals are minted by tpusim/obs/reqtrace.py
+    # alone — the serving layer and CLI carry them opaquely through
+    # metrics_values()/the fleet merge, which is what keeps the
+    # one-writer collision audit clean
+    "reqtrace_": (
+        "tpusim/obs/", "tpusim/serve/", "tpusim/__main__.py",
+        "ci/check_golden.py",
+    ),
 }
 
 #: keys deliberately shared across surfaces, with the subsystems licensed

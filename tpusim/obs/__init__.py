@@ -15,7 +15,11 @@ the exit sentinel.  tpusim's rebuild is this package:
   HBM traffic, ICI occupancy, and (via the power coefficients) watts;
 * :mod:`tpusim.obs.export` — Perfetto **counter tracks** merged into the
   Chrome trace, a JSONL samples file, and Prometheus-style text for the
-  harness.
+  harness;
+* :mod:`tpusim.obs.reqtrace` — **request-scoped tracing** for the
+  serving fleet (L24): per-request span trees over the shared monotonic
+  clock, per-route/per-phase latency histograms with fixed log-spaced
+  bounds, and a bounded tail-sampling flight recorder.
 
 End-of-run aggregates stay in :mod:`tpusim.sim.stats`; the per-op Chrome
 trace stays in :mod:`tpusim.sim.traceviz`; this package adds the
@@ -35,11 +39,24 @@ from tpusim.obs.export import (
     pod_chrome_trace,
     prometheus_text,
     read_samples_jsonl,
+    request_chrome_trace,
     validate_obs_dir,
     validate_sample_rows,
     window_rows,
     write_obs_dir,
     write_samples_jsonl,
+)
+from tpusim.obs.reqtrace import (
+    BUCKET_BOUNDS_MS,
+    TRACE_CTX_KEY,
+    TRACE_HEADER,
+    AccessLog,
+    FlightRecorder,
+    LatencyHistogram,
+    RequestTrace,
+    RequestTracer,
+    histogram_exposition,
+    mint_trace_id,
 )
 
 __all__ = [
@@ -54,9 +71,20 @@ __all__ = [
     "pod_chrome_trace",
     "prometheus_text",
     "read_samples_jsonl",
+    "request_chrome_trace",
     "validate_obs_dir",
     "validate_sample_rows",
     "window_rows",
     "write_obs_dir",
     "write_samples_jsonl",
+    "BUCKET_BOUNDS_MS",
+    "TRACE_CTX_KEY",
+    "TRACE_HEADER",
+    "AccessLog",
+    "FlightRecorder",
+    "LatencyHistogram",
+    "RequestTrace",
+    "RequestTracer",
+    "histogram_exposition",
+    "mint_trace_id",
 ]

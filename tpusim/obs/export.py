@@ -28,6 +28,7 @@ __all__ = [
     "prometheus_metric_name",
     "prometheus_text",
     "read_samples_jsonl",
+    "request_chrome_trace",
     "validate_obs_dir",
     "validate_sample_rows",
     "window_rows",
@@ -225,6 +226,40 @@ def pod_chrome_trace(
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def request_chrome_trace(doc: dict) -> dict:
+    """Chrome/Perfetto trace for one serve-request trace document
+    (see ``tpusim.obs.reqtrace``) — the request-grain counterpart of
+    :func:`pod_chrome_trace`, so a slow serve request and a simulated
+    pod render in the same viewer.
+
+    Span ``start_ms``/``dur_ms`` are relative to the trace start;
+    Chrome wants microseconds.  All spans share one thread lane — they
+    nest on the shared monotonic clock, so the viewer renders the tier
+    flame directly."""
+    trace_id = doc.get("trace_id", "")
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": f"tpusim serve {trace_id}"}},
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": f"{doc.get('route', '?')} "
+                          f"[{doc.get('status', '?')}]"}},
+        {"name": f"request:{doc.get('route', '?')}", "ph": "X",
+         "pid": 0, "tid": 0, "ts": 0.0,
+         "dur": max(float(doc.get("total_ms") or 0.0) * 1000.0, 0.001),
+         "args": {"trace_id": trace_id,
+                  "status": doc.get("status"),
+                  "acceptor": doc.get("acceptor")}},
+    ]
+    for span in doc.get("spans", ()):
+        events.append({
+            "name": span["path"], "ph": "X", "pid": 0, "tid": 0,
+            "ts": float(span["start_ms"]) * 1000.0,
+            "dur": max(float(span["dur_ms"]) * 1000.0, 0.001),
+            "args": {"path": span["path"]},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text
 # ---------------------------------------------------------------------------
@@ -317,7 +352,13 @@ def prometheus_text(
                 str(help_line).replace("\\", "\\\\").replace("\n", "\\n")
             )
             lines.append(f"# HELP {name} {escaped}")
-        lines.append(f"# TYPE {name} gauge")
+        # explicit counter-suffix rule: `*_total` is the prometheus
+        # naming convention for monotone counters, and every tpusim
+        # `_total` key is in fact monotone (request/error/restart
+        # accounting) — everything else stays a gauge.  Scrapers that
+        # ignored the TYPE line see identical samples.
+        mtype = "counter" if name.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name}{label_part} {_prom_number(v)}")
     return "\n".join(lines) + "\n"
 

@@ -44,6 +44,8 @@ import time
 import urllib.parse
 from dataclasses import dataclass, field
 
+from tpusim.obs.reqtrace import TRACE_HEADER
+
 __all__ = ["JobStatus", "LintReport", "ServeClient", "ServeError", "SimResult"]
 
 
@@ -225,6 +227,12 @@ class ServeClient:
                 sent = True
                 resp = conn.getresponse()
                 payload = resp.read()
+                # request tracing (off by default server-side): remember
+                # the last trace id this thread's requests were assigned
+                # so callers can fetch the span tree afterwards
+                tid = resp.getheader(TRACE_HEADER)
+                if tid:
+                    self._local.last_trace_id = tid
                 return resp, payload
             except (http.client.HTTPException, ConnectionError,
                     BrokenPipeError, TimeoutError) as e:
@@ -350,6 +358,43 @@ class ServeClient:
         return list(
             self._request("GET", "/v1/traces", timeout_s=timeout_s)
             .get("traces", [])
+        )
+
+    @property
+    def last_trace_id(self) -> str | None:
+        """The request-trace id of this THREAD's most recent response,
+        or None when the server runs with tracing off (the default)."""
+        return getattr(self._local, "last_trace_id", None)
+
+    def recent_traces(
+        self, timeout_s: float | None = None,
+    ) -> list[dict]:
+        """Flight-recorder summaries (slowest-first; the whole fleet's
+        when the daemon is a multi-acceptor front).  Requires the
+        server to run with ``--trace-requests``."""
+        return list(
+            self._request(
+                "GET", "/v1/debug/traces", timeout_s=timeout_s,
+            ).get("traces", [])
+        )
+
+    def trace_detail(
+        self, trace_id: str, chrome: bool = False,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """One recorded span tree by id (``chrome=True`` returns the
+        Perfetto/Chrome ``traceEvents`` document instead)."""
+        path = f"/v1/debug/traces/{trace_id}"
+        if chrome:
+            resp, payload = self._raw(
+                "GET", path + "?format=chrome", timeout_s=timeout_s,
+            )
+            if resp.status != 200:
+                raise ServeError(resp.status, "http_error", resp.reason)
+            return dict(json.loads(payload))
+        return dict(
+            self._request("GET", path, timeout_s=timeout_s)
+            .get("trace", {})
         )
 
     def simulate(

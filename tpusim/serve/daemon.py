@@ -71,6 +71,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpusim.guard.cancel import CancelToken, OperationCancelled
+from tpusim.obs.reqtrace import TRACE_HEADER
 from tpusim.serve.admission import (
     AdmissionController,
     DeadlineExceeded,
@@ -116,6 +117,31 @@ def _prewarm_pricing_stack() -> None:
     model_version()  # memoized source-hash pass
 
 
+def _get_route(path: str) -> str:
+    """Histogram/access-log route label for a GET path — a small fixed
+    vocabulary, never raw paths (unbounded label cardinality would let
+    one curl loop grow /metrics without bound)."""
+    if path == "/healthz":
+        return "healthz"
+    if path == "/metrics":
+        return "metrics"
+    if path == "/v1/traces":
+        return "traces"
+    if path.startswith("/v1/debug/traces"):
+        return "debug"
+    if path.startswith("/v1/jobs/"):
+        return "jobs"
+    return "other"
+
+
+def _post_route(path: str) -> str:
+    """Route label for a POST path (same fixed-vocabulary rule)."""
+    if path in ("/v1/simulate", "/v1/lint", "/v1/sweep", "/v1/campaign",
+                "/v1/advise", "/v1/fleet"):
+        return path.rsplit("/", 1)[1]
+    return "other"
+
+
 class _Handler(BaseHTTPRequestHandler):
     """Protocol-only; all policy lives in the daemon's layers."""
 
@@ -133,6 +159,14 @@ class _Handler(BaseHTTPRequestHandler):
     # pricing is unaffected (no read is outstanding while we work).
     timeout = 60.0
 
+    # request-trace state, reset per request in parse_request (one
+    # handler instance serves every request on a keep-alive connection)
+    _trace = None
+    _route = None
+    _parse_t0 = None
+    _finished_tid = None
+    _relay_tid = None
+
     # -- plumbing ------------------------------------------------------------
 
     def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
@@ -140,18 +174,104 @@ class _Handler(BaseHTTPRequestHandler):
         if d is not None and d.verbose:
             super().log_message(fmt, *args)
 
+    def parse_request(self):
+        # stamped AFTER the request line was read (so keep-alive idle
+        # time between requests never pollutes the http_parse span) and
+        # only when some observability surface is on — tracing off
+        # means this hook costs one attribute test per request
+        self._trace = None
+        self._route = None
+        self._parse_t0 = None
+        self._finished_tid = None
+        self._relay_tid = None
+        d = self.daemon_obj
+        if d is not None and (
+            d.reqtrace is not None or d.access_log is not None
+        ):
+            self._parse_t0 = time.monotonic()
+        return super().parse_request()
+
+    def _track(self, route: str) -> None:
+        """Begin per-request observability for a *counted* request —
+        called exactly where ``serve_requests_total`` increments, so
+        the latency histograms' counts sum to that counter."""
+        d = self.daemon_obj
+        self._route = route
+        rt = d.reqtrace
+        if rt is None:
+            return
+        tr = rt.begin(
+            route, self.headers.get(TRACE_HEADER),
+            start_s=self._parse_t0,
+        )
+        acc = d.pop_accept_ts(self.connection)
+        if acc is not None:
+            # fd-passing front: the parent's accept timestamp rode the
+            # send_fds message; the span covers accept -> child recv
+            tr.note_fd_dispatch(acc[0], acc[1])
+        if self._parse_t0 is not None:
+            tr.add_span(
+                "http_parse", self._parse_t0,
+                time.monotonic() - self._parse_t0,
+            )
+        self._trace = tr
+
+    def _finalize(self, status: int) -> str | None:
+        """Complete per-request observability (idempotent; called by
+        every send helper, possibly twice for early-observing routes
+        like ``/metrics``).  Returns the trace ID for the response
+        header, if any."""
+        d = self.daemon_obj
+        tr = self._trace
+        if tr is not None:
+            self._trace = None
+            doc = d.reqtrace.finish(tr, status)
+            self._finished_tid = tr.trace_id
+            if d.access_log is not None:
+                self._route = None
+                d.access_log.write(
+                    route=tr.route, status=status,
+                    latency_ms=doc["total_ms"], trace_id=tr.trace_id,
+                    tier=(doc.get("meta") or {}).get("tier"),
+                    acceptor=d.acceptor_index,
+                )
+            return self._finished_tid
+        if self._finished_tid is not None:
+            return self._finished_tid
+        if self._relay_tid is not None:
+            return self._relay_tid
+        if d.access_log is not None and self._route is not None:
+            route = self._route
+            self._route = None  # one access-log line per request
+            latency_ms = (
+                (time.monotonic() - self._parse_t0) * 1000.0
+                if self._parse_t0 is not None else 0.0
+            )
+            d.access_log.write(
+                route=route, status=status, latency_ms=latency_ms,
+                acceptor=d.acceptor_index,
+            )
+        return None
+
     def _send_json(
         self, status: int, doc: dict, headers: dict | None = None,
     ) -> None:
         d = self.daemon_obj
+        tr = self._trace
+        t_resp = time.monotonic() if tr is not None else 0.0
         body = json.dumps({
             "format_version": SERVE_FORMAT_VERSION,
             "model_version": d.worker.model_version,
             **doc,
         }, sort_keys=True).encode() + b"\n"
+        if tr is not None:
+            tr.add_span("respond", t_resp, time.monotonic() - t_resp)
+        tid = self._finalize(status)
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if tid:
+            self.send_header(TRACE_HEADER, tid)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -166,9 +286,12 @@ class _Handler(BaseHTTPRequestHandler):
         response, or a hot-cache ``memoryview`` — both already carry the
         format/model_version envelope.  A memoryview goes to the socket
         without an intermediate copy (the serve v3 zero-copy path)."""
+        tid = self._finalize(status)
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if tid:
+            self.send_header(TRACE_HEADER, tid)
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -178,9 +301,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_text(self, status: int, text: str, content_type: str) -> None:
         body = text.encode()
+        tid = self._finalize(status)
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if tid:
+            self.send_header(TRACE_HEADER, tid)
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -191,6 +317,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _read_body(self) -> dict | None:
         """Size-capped JSON body; sends the error response itself and
         returns None on refusal."""
+        tr = self._trace
+        if tr is None:
+            return self._read_body_inner()
+        with tr.span("parse"):
+            return self._read_body_inner()
+
+    def _read_body_inner(self) -> dict | None:
         d = self.daemon_obj
         try:
             length = int(self.headers.get("Content-Length", "0") or "0")
@@ -241,6 +374,7 @@ class _Handler(BaseHTTPRequestHandler):
         # counters by N-1 on every scrape/health poll
         if path != "/-/stats" and not local:
             d._count("serve_requests_total")
+            self._track(_get_route(path))
         if path == "/healthz":
             if d.admission.draining:
                 self._send_json(503, {"status": "draining"})
@@ -250,11 +384,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, d.local_healthz())
         elif path == "/metrics":
             d._count("serve_requests_metrics_total")
+            if self._trace is not None:
+                # observe THIS scrape before rendering, so the
+                # histogram bucket counts in the document it returns
+                # sum exactly to serve_requests_total (finalize is
+                # idempotent; _send_text reuses the frozen trace ID)
+                self._finalize(200)
             text = (
                 d.fleet_metrics_text()
                 if d.in_fleet and not local else d.metrics_text()
             )
             self._send_text(200, text, "text/plain; version=0.0.4")
+        elif path == "/v1/debug/traces" or \
+                path.startswith("/v1/debug/traces/"):
+            self._debug_traces(path, query, local)
         elif path == "/-/stats":
             # fleet-internal: this acceptor's raw metric values as JSON
             # (the peer merging /metrics sums these; JSON beats parsing
@@ -283,6 +426,7 @@ class _Handler(BaseHTTPRequestHandler):
         d = self.daemon_obj
         d._count("serve_requests_total")
         path = self.path.split("?", 1)[0].rstrip("/")
+        self._track(_post_route(path))
         if path == "/v1/simulate":
             d._count("serve_requests_simulate_total")
             body = self._read_body()
@@ -304,16 +448,29 @@ class _Handler(BaseHTTPRequestHandler):
                     float(body["deadline_ms"])
                 except (TypeError, ValueError):
                     deadline_ok = False
+            tr = self._trace
+            t_hot = time.monotonic() if tr is not None else 0.0
             hot_key = (
                 d.hot_key_for("simulate", body) if deadline_ok else None
             )
-            if hot_key is not None and not d.admission.draining:
-                blob = d.hot.get(hot_key)
-                if blob is not None:
-                    # serve_hot_hits_total rides /metrics from the hot
-                    # store's own counters — not double-counted here
-                    self._send_body(200, blob)
-                    return
+            blob = (
+                d.hot.get(hot_key)
+                if hot_key is not None and not d.admission.draining
+                else None
+            )
+            if tr is not None:
+                # one span covers key derivation + the mmap lookup —
+                # the whole of what a hot hit pays
+                tr.add_span(
+                    "hot_lookup", t_hot, time.monotonic() - t_hot,
+                )
+            if blob is not None:
+                # serve_hot_hits_total rides /metrics from the hot
+                # store's own counters — not double-counted here
+                if tr is not None:
+                    tr.meta["tier"] = "hot"
+                self._send_body(200, blob)
+                return
             self._run_sync(
                 "simulate", d.worker.simulate, body=body, hot_key=hot_key,
             )
@@ -396,6 +553,7 @@ class _Handler(BaseHTTPRequestHandler):
         d = self.daemon_obj
         d._count("serve_requests_total")
         path = self.path.split("?", 1)[0].rstrip("/")
+        self._track("jobs" if path.startswith("/v1/jobs/") else "other")
         if not path.startswith("/v1/jobs/"):
             self._send_json(404, {
                 "error": "unknown_route", "detail": f"no route {path!r}",
@@ -416,6 +574,51 @@ class _Handler(BaseHTTPRequestHandler):
             d._count("serve_jobs_cancel_requests_total")
         self._send_json(200, {"job_id": job_id, "status": status})
 
+    def _debug_traces(self, path: str, query: str, local: bool) -> None:
+        """``GET /v1/debug/traces`` (summaries, slowest first, fleet-
+        merged) and ``/v1/debug/traces/<id>`` (one span tree; add
+        ``?format=chrome`` for the Perfetto/Chrome export).  404 when
+        tracing is off — the debug surface only exists when the flight
+        recorder does."""
+        d = self.daemon_obj
+        rt = d.reqtrace
+        if rt is None:
+            self._send_json(404, {
+                "error": "tracing_disabled",
+                "detail": (
+                    "start the daemon with --trace-requests to record "
+                    "request traces"
+                ),
+            })
+            return
+        if path == "/v1/debug/traces":
+            docs = rt.traces_doc()
+            if d.in_fleet and not local:
+                docs = d.fleet_traces_doc(docs)
+            self._send_json(200, {"traces": docs})
+            return
+        trace_id = path.rsplit("/", 1)[1]
+        doc = rt.get(trace_id)
+        if doc is None and d.in_fleet and not local:
+            doc = d.fleet_trace_get(trace_id)
+        if doc is None:
+            self._send_json(404, {
+                "error": "unknown_trace",
+                "detail": f"no recorded trace {trace_id!r}",
+            })
+            return
+        if "format=chrome" in query:
+            from tpusim.obs.export import request_chrome_trace
+
+            # the raw viewer document, no response envelope: this body
+            # is meant to be saved and loaded into Perfetto/chrome as-is
+            self._send_text(
+                200, json.dumps(request_chrome_trace(doc), sort_keys=True),
+                "application/json",
+            )
+            return
+        self._send_json(200, {"trace": doc})
+
     def _proxy_to_primary(self, method: str, path: str, raw) -> None:
         """Forward one job-family request to the primary acceptor's
         direct listener (serve v3: the JobTable is single-owner).  The
@@ -428,6 +631,13 @@ class _Handler(BaseHTTPRequestHandler):
         # without this compensation every proxied job request would
         # show as TWO requests in the fleet-summed /metrics
         d._count("serve_requests_total", -1.0)
+        # the same rule governs tracing: drop this acceptor's trace
+        # (never observed/recorded — the fleet histogram counts must
+        # keep summing to serve_requests_total) and propagate its ID
+        # over the hop so the PRIMARY records the span tree under it
+        tr = self._trace
+        self._trace = None
+        self._route = None
         target = d.primary_direct
         if target is None:
             d._count("serve_proxy_unavailable_total")
@@ -444,9 +654,17 @@ class _Handler(BaseHTTPRequestHandler):
             headers = {"Accept": "application/json"}
             if raw:
                 headers["Content-Type"] = "application/json"
+            if tr is not None:
+                headers[TRACE_HEADER] = tr.trace_id
             conn.request(method, path, body=raw or None, headers=headers)
             resp = conn.getresponse()
             payload = resp.read()
+            if tr is not None:
+                # relay the primary's (== our pinned) trace ID on the
+                # response we forward back to the client
+                self._relay_tid = (
+                    resp.getheader(TRACE_HEADER) or tr.trace_id
+                )
             conn.close()
         except (OSError, http.client.HTTPException):
             d._count("serve_proxy_unavailable_total")
@@ -497,13 +715,36 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
             }, headers={"Retry-After": 2})
             return
+        tr = self._trace
         try:
+            t_adm = time.monotonic()
             with d.admission.admit(deadline):
+                if tr is not None:
+                    # the admission span is the queue wait: admit()
+                    # blocks in __enter__ until a slot frees
+                    tr.add_span(
+                        "admission", t_adm, time.monotonic() - t_adm,
+                    )
                 if d.work_hook is not None:
                     d.work_hook(endpoint, body)
                 if time.monotonic() >= deadline:
                     raise DeadlineExceeded("deadline expired at admission")
-                result = d.execute_sync(endpoint, fn, body, deadline)
+                if tr is None:
+                    result = d.execute_sync(endpoint, fn, body, deadline)
+                else:
+                    t_disp = time.monotonic()
+                    try:
+                        result = d.execute_sync(
+                            endpoint, fn, body, deadline, reqtrace=tr,
+                        )
+                    finally:
+                        # recorded on the way out even for the 504/422
+                        # ladder below — those are the traces the
+                        # recorder's error ring exists for
+                        tr.add_span(
+                            "dispatch", t_disp,
+                            time.monotonic() - t_disp,
+                        )
         except RequestError as e:
             if e.status == 400:
                 d._count("serve_validation_400_total")
@@ -638,6 +879,8 @@ class ServeDaemon:
         quarantine_dir=None,
         close_fds=(),
         worker_close_fds=(),
+        trace_requests: bool = False,
+        access_log=None,
     ):
         from pathlib import Path
 
@@ -840,6 +1083,40 @@ class ServeDaemon:
         self._counter_lock = threading.Lock()
         self._clock0 = time.monotonic()
 
+        # request-scoped tracing (L24, tpusim.obs.reqtrace): OFF by
+        # default — None means the handler pays one attribute test per
+        # request, zero new stats keys, byte-identical responses
+        self.reqtrace = None
+        if trace_requests:
+            from tpusim.obs.reqtrace import RequestTracer
+
+            self.reqtrace = RequestTracer(acceptor_index=acceptor_index)
+        # structured JSONL access log (independent of tracing; lines
+        # carry trace IDs only when tracing is also on)
+        self.access_log = None
+        if access_log:
+            from tpusim.obs.reqtrace import AccessLog
+
+            log_path = (
+                Path(access_log) if isinstance(access_log, (str, Path))
+                else (
+                    self.state_dir / "access.jsonl"
+                    if self.state_dir else Path("tpusim-access.jsonl")
+                )
+            )
+            if self.in_fleet:
+                # one file per acceptor: concurrent writers rotating one
+                # shared file would race each other's os.replace
+                log_path = log_path.with_name(
+                    f"{log_path.stem}.{acceptor_index}{log_path.suffix}"
+                )
+            self.access_log = AccessLog(log_path)
+        # fd-passing front mode: accept timestamps for in-flight handed
+        # connections, keyed by socket identity until the first request
+        # on each connection claims its fd_dispatch span
+        self._accept_ts: dict[int, tuple[float, float]] = {}
+        self._accept_lock = threading.Lock()
+
     # -- counters ------------------------------------------------------------
 
     def _count(self, key: str, delta: float = 1.0) -> None:
@@ -899,19 +1176,32 @@ class ServeDaemon:
                 values[f"guard_{k}"] = v
         for k, v in self._guard_startup.items():
             values[f"guard_{k}"] = v
+        # request-trace histograms + recorder counters — ONLY when
+        # tracing is active (the guard_* discipline on /metrics: a
+        # tracing-off daemon's scrape and /-/stats are key-identical)
+        if self.reqtrace is not None:
+            values.update(self.reqtrace.metrics_values())
         return values
 
     @staticmethod
     def _render_metrics(values: dict[str, float]) -> str:
         from tpusim.obs.export import prometheus_text
+        from tpusim.obs.reqtrace import histogram_exposition
 
-        return prometheus_text(
-            values,
+        # split the (possibly fleet-merged) latency-histogram state out
+        # first: its keys render as real histogram-typed series, and
+        # everything else stays on the hardened gauge/counter path
+        rest, hist_lines = histogram_exposition(values)
+        text = prometheus_text(
+            rest,
             help_text={
                 "serve_requests_total": "HTTP requests received",
                 "serve_uptime_s": "seconds since daemon start",
             },
         )
+        if hist_lines:
+            text += "\n".join(hist_lines) + "\n"
+        return text
 
     def metrics_text(self) -> str:
         """The ``/metrics`` document — every serve counter plus the
@@ -1072,6 +1362,39 @@ class ServeDaemon:
 
     # -- hot-response tier (serve v3) ----------------------------------------
 
+    def fleet_traces_doc(self, local_docs: list, limit: int = 50) -> list:
+        """Fleet-merged slow-trace summaries: this acceptor's plus every
+        peer's local list, re-sorted slowest first.  Any acceptor can
+        answer ``GET /v1/debug/traces`` for the whole fleet."""
+        docs = list(local_docs)
+        for _idx, doc in self._fetch_peers_json(
+            "/v1/debug/traces?scope=local"
+        ).items():
+            peer_traces = (doc or {}).get("traces")
+            if isinstance(peer_traces, list):
+                docs.extend(
+                    t for t in peer_traces if isinstance(t, dict)
+                )
+        docs.sort(key=lambda t: t.get("total_ms", 0.0), reverse=True)
+        return docs[: max(int(limit), 0)]
+
+    def fleet_trace_get(self, trace_id: str) -> dict | None:
+        """By-ID fleet fallback: ask every peer's local recorder for a
+        trace this acceptor never saw (requests balance across
+        acceptors, so the slowest trace rarely lives where the debug
+        query lands)."""
+        from tpusim.obs.reqtrace import valid_trace_id
+
+        if not valid_trace_id(trace_id):
+            return None
+        for _idx, doc in self._fetch_peers_json(
+            f"/v1/debug/traces/{trace_id}?scope=local"
+        ).items():
+            trace = (doc or {}).get("trace")
+            if isinstance(trace, dict):
+                return trace
+        return None
+
     def _trace_fingerprint(self, name: str) -> str | None:
         """A cheap stat fingerprint of one named trace directory
         (file names + sizes + mtimes), cached per name.  Joins the hot
@@ -1166,16 +1489,33 @@ class ServeDaemon:
 
     # -- sync dispatch -------------------------------------------------------
 
-    def execute_sync(self, endpoint: str, fn, body: dict, deadline: float):
+    def execute_sync(self, endpoint: str, fn, body: dict, deadline: float,
+                     reqtrace=None):
         """One admitted synchronous request: through the supervised
         worker pool when mounted (crash isolation, cooperative deadline
         cancel with kill escalation, quarantine — the serve v2 path),
         else the in-process worker (``fn``) pricing under a
         :class:`~tpusim.guard.CancelToken` armed with the same deadline.
-        Responses are byte-identical either way."""
+        Responses are byte-identical either way.  ``reqtrace`` collects
+        the worker-side tier spans (both paths time over the shared
+        monotonic clock, so they merge without alignment)."""
         if self.supervisor is not None:
-            return self.supervisor.execute(endpoint, body, deadline=deadline)
-        return fn(body, cancel=CancelToken(deadline=deadline))
+            return self.supervisor.execute(
+                endpoint, body, deadline=deadline, reqtrace=reqtrace,
+            )
+        cancel = CancelToken(deadline=deadline)
+        if reqtrace is None:
+            return fn(body, cancel=cancel)
+        spans: list = []
+        try:
+            result = fn(body, cancel=cancel, spans=spans)
+        finally:
+            reqtrace.add_worker_spans(spans)
+        if isinstance(result, dict) and "cache_hit" in result:
+            reqtrace.meta["tier"] = (
+                "warm" if result.get("cache_hit") else "priced"
+            )
+        return result
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1197,13 +1537,34 @@ class ServeDaemon:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def inject_connection(self, sock, addr) -> None:
+    def inject_connection(self, sock, addr, accepted_s=None) -> None:
         """Dispatch one already-accepted connection into this daemon's
         HTTP stack — the fd-passing fallback path on kernels without
         ``SO_REUSEPORT`` (the front parent accepts and ships the fd via
-        ``socket.send_fds``; this acceptor parses and serves it)."""
+        ``socket.send_fds``; this acceptor parses and serves it).
+        ``accepted_s`` is the front parent's monotonic accept timestamp
+        (shared clock): when tracing is on, the first request on this
+        connection gets an ``fd_dispatch`` span covering the handoff."""
+        if self.reqtrace is not None and accepted_s is not None:
+            self._note_accepted(sock, float(accepted_s))
         server = self._direct_httpd or self._httpd
         server.process_request(sock, addr)
+
+    def _note_accepted(self, sock, accepted_s: float) -> None:
+        with self._accept_lock:
+            if len(self._accept_ts) > 1024:
+                # connections that never issued a request would leak
+                # their stamps; a full map means exactly that — reset
+                self._accept_ts.clear()
+            self._accept_ts[id(sock)] = (accepted_s, time.monotonic())
+
+    def pop_accept_ts(self, sock) -> tuple[float, float] | None:
+        """Claim (once) the fd-passing accept/handoff timestamps for a
+        handler's connection; None on the reuseport/direct path."""
+        if not self._accept_ts:
+            return None
+        with self._accept_lock:
+            return self._accept_ts.pop(id(sock), None)
 
     def start(self) -> "ServeDaemon":
         """Bind the listener and start serving on background threads.
@@ -1419,6 +1780,8 @@ class ServeDaemon:
             if srv is not None:
                 srv.shutdown()
                 srv.server_close()
+        if self.access_log is not None:
+            self.access_log.close()
         self._stopped.set()
         return clean
 
@@ -1440,6 +1803,8 @@ class ServeDaemon:
             if srv is not None:
                 srv.shutdown()
                 srv.server_close()
+        if self.access_log is not None:
+            self.access_log.close()
         self._stopped.set()
 
     def wait_stopped(self, timeout_s: float | None = None) -> bool:

@@ -45,6 +45,7 @@ import multiprocessing
 import os
 import signal
 import socket
+import struct
 import threading
 import time
 
@@ -130,6 +131,8 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
         hot_cache=settings.get("hot_cache"),
         hot_quota_bytes=settings.get("hot_quota_bytes"),
         strict_lint=settings.get("strict_lint", False),
+        trace_requests=settings.get("trace_requests", False),
+        access_log=settings.get("access_log"),
         acceptor_index=index,
         acceptors_total=settings.get("acceptors_total", 0),
         reuse_port=not fd_mode and bool(settings.get("reuse_port", True)),
@@ -179,13 +182,22 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
         def _fd_loop():
             while True:
                 try:
-                    _msg, fds, _flags, _addr = socket.recv_fds(
+                    msg, fds, _flags, _addr = socket.recv_fds(
                         fd_sock, 16, 4,
                     )
                 except OSError:
                     return
                 if not fds:
                     return  # parent closed its end: we are draining
+                # the parent stamps its monotonic accept time into the
+                # send_fds message (shared clock across fork): request
+                # tracing turns it into the fd_dispatch span
+                accepted_s = None
+                if len(msg) >= 8:
+                    try:
+                        accepted_s = struct.unpack("<d", msg[:8])[0]
+                    except struct.error:
+                        accepted_s = None
                 for fd in fds:
                     try:
                         client = socket.socket(fileno=fd)
@@ -203,6 +215,7 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
                     try:
                         daemon.inject_connection(
                             client, client.getpeername(),
+                            accepted_s=accepted_s,
                         )
                     except OSError:
                         try:
@@ -565,7 +578,14 @@ class FrontSupervisor:
                 if fd_sock is None:
                     continue
                 try:
-                    socket.send_fds(fd_sock, [b"c"], [client.fileno()])
+                    # the message carries the accept timestamp (shared
+                    # monotonic clock) for the acceptor's fd_dispatch
+                    # span; receivers that predate it ignored the bytes
+                    socket.send_fds(
+                        fd_sock,
+                        [struct.pack("<d", time.monotonic())],
+                        [client.fileno()],
+                    )
                     sent = True
                     break
                 except OSError:

@@ -67,7 +67,7 @@ __all__ = ["CooperativeCancel", "Supervisor", "WorkerSlot", "WorkerTimeout"]
 #: long a request may run, never what it prices (a poison request with a
 #: different deadline is the same poison; ``_budget_s`` is the shipped
 #: remaining-deadline budget of the cooperative-cancellation frame)
-_VOLATILE_BODY_KEYS = ("deadline_ms", "_budget_s")
+_VOLATILE_BODY_KEYS = ("deadline_ms", "_budget_s", "_trace_ctx")
 
 #: restart backoff ceiling — a flapping worker must not sleep forever
 MAX_RESTART_BACKOFF_S = 30.0
@@ -609,10 +609,13 @@ class Supervisor:
 
     def _round_trip(
         self, slot: WorkerSlot, endpoint: str, body: dict,
-        deadline: float | None,
-    ) -> tuple[str, object]:
+        deadline: float | None, trace_ctx: bool = False,
+    ) -> tuple[str, object, dict | None]:
         """One request over one worker's pipe.  Returns the worker's
-        ``(kind, payload)``; raises :class:`WorkerTimeout` after killing
+        ``(kind, payload, trace_extras)`` — ``trace_extras`` is the
+        optional ``"spans"`` frame a tracing-aware child sends just
+        before its final frame (worker-side span timings + cache tier),
+        ``None`` otherwise; raises :class:`WorkerTimeout` after killing
         a worker that outlived the deadline, :class:`_WorkerGone` when
         the worker died without ever acking the request (not charged to
         the poison budget), ``ConnectionError`` on a mid-request death
@@ -622,13 +625,20 @@ class Supervisor:
             req_id = self._req_seq
         conn = slot.conn
         acked = False
+        trace_extras: dict | None = None
+        if deadline is not None or trace_ctx:
+            body = dict(body or {})
         if deadline is not None:
             # ship the remaining budget so the child arms its own
             # CancelToken (tokens never cross pipes); the signal kill
             # below becomes the ESCALATION past the cooperative grace,
             # not the first resort
-            body = dict(body or {})
             body["_budget_s"] = max(deadline - time.monotonic(), 0.0)
+        if trace_ctx:
+            # volatile marker (stripped from the affinity/quarantine
+            # content hash like _budget_s): the child times its tiers
+            # and ships them back in an extra "spans" frame
+            body["_trace_ctx"] = True
         try:
             conn.send((req_id, endpoint, body))
         except (BrokenPipeError, OSError):
@@ -661,7 +671,13 @@ class Supervisor:
                         if msg[1] == "ack":
                             acked = True  # the worker READ the request
                             continue
-                        return msg[1], msg[2]
+                        if msg[1] == "spans":
+                            # worker-side span timings ride ahead of
+                            # the final frame; stash, keep polling
+                            if isinstance(msg[2], dict):
+                                trace_extras = msg[2]
+                            continue
+                        return msg[1], msg[2], trace_extras
                     continue  # stale frame from a pre-kill epoch
             except (EOFError, OSError):
                 self._mark_dead(slot, commanded=False)
@@ -693,13 +709,17 @@ class Supervisor:
 
     def execute(
         self, endpoint: str, body: dict, deadline: float | None = None,
+        reqtrace=None,
     ) -> dict:
         """Price one request through the fleet, applying every policy in
         the module docstring.  Returns the worker's response dict;
         raises :class:`~tpusim.serve.worker.RequestError` (passthrough
         and quarantine), :class:`Degraded`, :class:`WorkerTimeout`, or
         ``RuntimeError`` (the worker survived but the request blew up —
-        the HTTP layer's 500 boundary)."""
+        the HTTP layer's 500 boundary).  ``reqtrace`` (a
+        :class:`tpusim.obs.reqtrace.RequestTrace`) opts the child into
+        span collection; its timings merge back as ``dispatch/*``
+        children over the shared monotonic clock."""
         key = self.affinity_key(endpoint, body)
         with self._lock:
             poison = self._quarantine.get(key)
@@ -724,8 +744,9 @@ class Supervisor:
         while True:
             slot = self._acquire_slot(key, deadline)
             try:
-                kind, payload = self._round_trip(
+                kind, payload, trace_extras = self._round_trip(
                     slot, endpoint, body, deadline,
+                    trace_ctx=reqtrace is not None,
                 )
             except _WorkerGone:
                 # the worker died without ever STARTING the request (no
@@ -763,6 +784,11 @@ class Supervisor:
                     slot.consecutive_failures = 0
             finally:
                 self._release_slot(slot)
+            if reqtrace is not None and trace_extras is not None:
+                reqtrace.add_worker_spans(trace_extras.get("spans") or ())
+                tier = trace_extras.get("tier")
+                if tier:
+                    reqtrace.meta["tier"] = tier
             if kind in ("ok", "ok_bytes"):
                 # ok_bytes is the final serialized response body (the
                 # worker's serialization IS the parent's, byte for byte)

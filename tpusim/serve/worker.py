@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 from tpusim.perf.cache import ResultCache
 from tpusim.timing.model_version import model_version
@@ -83,17 +84,36 @@ class _RequestCacheView(ResultCache):
     The driver stamps this view's ``stats_dict`` under ``cache_*``, so
     served reports carry per-request cache effectiveness."""
 
-    def __init__(self, shared: ResultCache):
+    def __init__(self, shared: ResultCache, timed: bool = False):
         super().__init__(disk_dir=None, max_entries=1)
         self._shared = shared
+        # request-trace probe accounting: first-probe start + total
+        # probe seconds, folded into ONE "cache_probe" span (a replay
+        # may probe per segment; per-probe spans would bloat the tree)
+        self._timed = timed
+        self._probe_t0: float | None = None
+        self._probe_s = 0.0
 
     def get(self, key):
-        result = self._shared.get(key)
+        if self._timed:
+            t0 = time.monotonic()
+            result = self._shared.get(key)
+            if self._probe_t0 is None:
+                self._probe_t0 = t0
+            self._probe_s += time.monotonic() - t0
+        else:
+            result = self._shared.get(key)
         if result is not None:
             self.hits += 1
         else:
             self.misses += 1
         return result
+
+    def probe_span(self) -> tuple[str, float, float] | None:
+        """The folded ``cache_probe`` span, or None if never probed."""
+        if self._probe_t0 is None:
+            return None
+        return ("cache_probe", self._probe_t0, self._probe_s)
 
     def put(self, key, result) -> None:
         self._shared.put(key, result)
@@ -353,19 +373,32 @@ class ServeWorker:
 
     # -- endpoints -----------------------------------------------------------
 
-    def simulate(self, req: dict, cancel=None) -> dict:
+    def simulate(self, req: dict, cancel=None, spans=None) -> dict:
         """``POST /v1/simulate`` — price one pod replay.  ``cancel``
         (a :class:`tpusim.guard.CancelToken` armed with the request's
         deadline) makes the replay cooperatively cancellable: the
         driver raises :class:`tpusim.guard.OperationCancelled` at the
         next command/op boundary, the HTTP layer answers 504, and this
-        worker — process or thread — survives with every cache warm."""
+        worker — process or thread — survives with every cache warm.
+        ``spans`` (request tracing) collects ``(name, abs_monotonic_s,
+        dur_s)`` tier timings — lint verdict, cache probe, pricing."""
         from tpusim.sim.driver import SimDriver
 
         entry, inline = self._resolve_entry(req)
         cfg = self._config_for(entry.pod, req)
         if self.strict_lint:
-            self._strict_lint_gate(entry, inline, req)
+            if spans is None:
+                self._strict_lint_gate(entry, inline, req)
+            else:
+                t_lint = time.monotonic()
+                try:
+                    self._strict_lint_gate(entry, inline, req)
+                finally:
+                    # a 422 refusal is the interesting trace — record
+                    # the verdict span on the way out either way
+                    spans.append(
+                        ("lint", t_lint, time.monotonic() - t_lint)
+                    )
         if bool(req.get("validate", True)):
             diags = self._analyze(entry, inline, cfg, req)
             if diags.has_errors:
@@ -381,11 +414,12 @@ class ServeWorker:
                     400, "bad_faults", f"fault schedule rejected: {e}"
                 )
         view = (
-            _RequestCacheView(self.result_cache)
+            _RequestCacheView(self.result_cache, timed=spans is not None)
             if self.result_cache is not None else None
         )
         from tpusim.faults import TopologyPartitionedError
 
+        t_price = time.monotonic()
         try:
             report = SimDriver(
                 cfg, faults=faults, result_cache=view,
@@ -397,6 +431,18 @@ class ServeWorker:
             raise RequestError(
                 422, "replay_failed", f"{type(e).__name__}: {e}"
             )
+        finally:
+            if spans is not None:
+                # price covers the whole driver run (compile rides
+                # inside it on a cold module); the folded cache-probe
+                # span overlaps it as a child-by-timing
+                spans.append(
+                    ("price", t_price, time.monotonic() - t_price)
+                )
+                if view is not None:
+                    probe = view.probe_span()
+                    if probe is not None:
+                        spans.append(probe)
         stats = json.loads(report.stats.to_json())
         self.priced += 1
         return {
@@ -410,14 +456,20 @@ class ServeWorker:
             "stats": stats,
         }
 
-    def lint(self, req: dict, cancel=None) -> dict:
+    def lint(self, req: dict, cancel=None, spans=None) -> dict:
         """``POST /v1/lint`` — the analyzer's report, never a refusal
         (lint findings are the payload, not an error).  ``cancel`` is
         accepted for endpoint-signature uniformity; analysis runs in
-        milliseconds, below any useful cancellation grain."""
+        milliseconds, below any useful cancellation grain.  ``spans``
+        (request tracing) collects the analyze timing."""
         entry, inline = self._resolve_entry(req)
         cfg = self._config_for(entry.pod, req)
+        t_analyze = time.monotonic()
         diags = self._analyze(entry, inline, cfg, req)
+        if spans is not None:
+            spans.append(
+                ("analyze", t_analyze, time.monotonic() - t_analyze)
+            )
         from tpusim.analysis.diagnostics import Severity
 
         return {
@@ -791,6 +843,15 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
 
             body = dict(body)
             cancel = CancelToken.after(float(body.pop("_budget_s")))
+        spans = None
+        if isinstance(body, dict) and "_trace_ctx" in body:
+            # request tracing is on: time this request's tiers and ship
+            # them back in an extra "spans" frame ahead of the final
+            # one.  The marker is volatile (stripped from content
+            # hashes) and must never reach the endpoint body.
+            body = dict(body)
+            body.pop("_trace_ctx")
+            spans = []
         if chaos and isinstance(body, dict):
             if body.get("_chaos_exit"):
                 os._exit(3)
@@ -815,18 +876,28 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
                     f"supervised workers serve {sorted(_CHILD_ENDPOINTS)},"
                     f" not {endpoint!r}",
                 )
-            result = getattr(worker, endpoint)(body, cancel=cancel)
+            result = getattr(worker, endpoint)(
+                body, cancel=cancel, spans=spans,
+            ) if spans is not None else getattr(worker, endpoint)(
+                body, cancel=cancel,
+            )
         except RequestError as e:
             out = (req_id, "request_error",
                    (e.status, e.code, e.detail, e.extra))
+            tier = None
         except OperationCancelled as e:
             # the deadline tripped INSIDE the pricing stack: this
             # process is healthy, its caches warm — the supervisor
             # answers 504 without killing anything
             out = (req_id, "cancelled", str(e))
+            tier = None
         except Exception as e:  # noqa: BLE001 - the worker's 500 boundary
             out = (req_id, "error", f"{type(e).__name__}: {e}")
+            tier = None
         else:
+            tier = None
+            if isinstance(result, dict) and "cache_hit" in result:
+                tier = "warm" if result.get("cache_hit") else "priced"
             if format_version is not None:
                 # serialize HERE, byte-for-byte what the parent's
                 # _send_json would produce (same dumps args, same
@@ -834,14 +905,24 @@ def worker_child_main(index: int, conn, settings: dict) -> None:
                 # to the socket instead of unpickling a ~10 KB stats
                 # dict and re-serializing it under its GIL — the hot
                 # half of the per-request parent cost
-                out = (req_id, "ok_bytes", json.dumps({
+                t_ser = _time.monotonic()
+                blob = json.dumps({
                     "format_version": format_version,
                     "model_version": worker.model_version,
                     **result,
-                }, sort_keys=True).encode() + b"\n")
+                }, sort_keys=True).encode() + b"\n"
+                if spans is not None:
+                    spans.append(
+                        ("serialize", t_ser, _time.monotonic() - t_ser)
+                    )
+                out = (req_id, "ok_bytes", blob)
             else:
                 out = (req_id, "ok", result)
         try:
+            if spans is not None:
+                # span frame rides ahead of the final frame; the bytes
+                # of the final frame are untouched by tracing
+                conn.send((req_id, "spans", {"spans": spans, "tier": tier}))
             conn.send(out)
         except (BrokenPipeError, OSError):
             return
