@@ -2103,6 +2103,342 @@ def reqtrace_smoke() -> dict:
     }
 
 
+#: --cluster-smoke campaign spec: small enough to run three times in
+#: one CI tier, big enough (2 slices x 6 scenarios) that a shard child
+#: is reliably mid-run when the chaos leg SIGKILLs it
+CLUSTER_CAMPAIGN_SPEC = {
+    "name": "ci-cluster-smoke",
+    "seed": 3,
+    "scenarios": 6,
+    "arch": "v5p",
+    "chips": 8,
+    "tuned": False,
+    "faults": {
+        "count": {"dist": "uniform", "min": 0, "max": 3},
+        "kinds": {"link_down": 1.0, "link_degraded": 1.0,
+                  "chip_straggler": 0.5, "hbm_throttle": 0.5},
+        "scale": {"min": 0.4, "max": 0.9},
+    },
+    "candidate_slices": [{"arch": "v5p", "chips": 4}],
+}
+
+
+def _shard_journal_sigs(out_dir) -> tuple[int, int]:
+    """(distinct scenario signatures, duplicate appends) across every
+    shard journal under ``<out>/shards/`` — duplicates == 0 is the
+    zero-re-priced-scenarios proof."""
+    from tpusim.campaign.journal import Journal
+
+    seen: set[tuple[str, int]] = set()
+    dup = 0
+    shards = Path(out_dir) / "shards"
+    for d in sorted(shards.iterdir()) if shards.is_dir() else []:
+        if not (d / "journal.jsonl").is_file():
+            continue
+        for rec in Journal(d).iter_records():
+            if rec.get("kind") != "scenario":
+                continue
+            sig = (rec["slice"], rec["index"])
+            if sig in seen:
+                dup += 1
+            seen.add(sig)
+    return len(seen), dup
+
+
+def cluster_smoke() -> dict:
+    """Multi-node cluster contract (serve --join + campaign --nodes):
+
+    1. **byte-identity across fleet sizes**: the golden matrix served
+       single-node, then through BOTH nodes of a 2-node localhost
+       cluster (hot + compiled tiers engaged, membership live,
+       consistent-hash forwarding in play), answers every request
+       byte-identical to the committed CLI goldens;
+    2. **node loss under traffic**: the second node SIGKILLed
+       mid-matrix costs ZERO failed requests (client failover + the
+       survivor's forward-fallback) and the primary records the heal
+       (a death, an epoch bump, nodes_alive back to 1);
+    3. **distributed campaign chaos**: ``--nodes 2`` sharded campaigns
+       merge to a report byte-identical to the uninterrupted
+       single-node run — with a shard child SIGKILLed mid-run (its
+       remaining scenarios resume on the survivor) and with the whole
+       coordinator killed then ``--resume``d — and in every case the
+       union of shard journals holds each scenario signature exactly
+       once: zero re-priced scenarios.
+    Raises on violation."""
+    import os
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    from tpusim.campaign import run_campaign, run_sharded_campaign
+    from tpusim.serve.client import ServeClient
+    from tpusim.serve.daemon import ServeDaemon
+
+    golden_bytes = _serve_golden_bytes
+    served_bytes = _serve_served_bytes
+
+    def matrix_names():
+        out = []
+        for fixture, arch, overlays in MATRIX:
+            name = f"{fixture}__{arch}"
+            tag = _overlay_tag(overlays)
+            if tag:
+                name += "__" + tag
+            out.append((name, fixture, arch, overlays))
+        return out
+
+    def matrix_pass(client, leg: str) -> int:
+        for name, fixture, arch, overlays in matrix_names():
+            r = client.simulate(
+                trace=fixture, arch=arch, overlays=list(overlays),
+                tuned=False,
+            )
+            if served_bytes(r.stats) != golden_bytes(name):
+                raise ValueError(
+                    f"cluster smoke [{leg}]: served stats for {name} "
+                    f"diverged from the committed CLI golden"
+                )
+        return len(MATRIX)
+
+    td = tempfile.mkdtemp(prefix="tpusim-ci-cluster-")
+    node_b = None
+    summary: dict = {}
+    try:
+        # -- leg 1: 2-node serve fleet, byte-identity + kill + heal ---
+        a = ServeDaemon(
+            trace_root=FIXTURES, max_inflight=4,
+            hot_cache=f"{td}/hot_a", compile_cache=f"{td}/cc_a",
+        ).start()
+        try:
+            client_a = ServeClient(a.url, retries=3)
+            configs = matrix_pass(client_a, "single-node")
+
+            node_b = subprocess.Popen(
+                [sys.executable, "-m", "tpusim", "serve", "--port", "0",
+                 "--trace-root", str(FIXTURES),
+                 "--join", f"{a.host}:{a.port}",
+                 "--hot-cache", f"{td}/hot_b",
+                 "--compile-cache", f"{td}/cc_b"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO,
+            )
+            boot_watchdog = threading.Timer(120, node_b.kill)
+            boot_watchdog.start()
+            url_b = None
+            try:
+                while True:
+                    line = node_b.stdout.readline()
+                    if not line:
+                        raise ValueError(
+                            f"node B exited before binding "
+                            f"(rc={node_b.poll()})"
+                        )
+                    if "listening on http://" in line:
+                        url_b = (
+                            "http://" +
+                            line.split("listening on http://", 1)[1]
+                            .split()[0].rstrip("/")
+                        )
+                        break
+            finally:
+                boot_watchdog.cancel()
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if a.cluster is not None and len([
+                    m for m in a.cluster.view()["members"] if m["alive"]
+                ]) == 2:
+                    break
+                time.sleep(0.1)
+            else:
+                raise ValueError(
+                    "node B never joined the primary's registry"
+                )
+
+            # both nodes serve the matrix with membership live:
+            # consistent-hash forwarding routes some requests across
+            # the wire, and every byte still matches the goldens
+            matrix_pass(ServeClient(a.url, retries=3), "2-node via A")
+            matrix_pass(ServeClient(url_b, retries=3), "2-node via B")
+
+            # chaos: kill node B mid-matrix; the failover client must
+            # finish the pass with zero failed requests
+            failover = ServeClient(url_b, retries=3, members=[a.url])
+            killed = False
+            for i, (name, fixture, arch, overlays) in enumerate(
+                matrix_names()
+            ):
+                if i == 1:
+                    node_b.send_signal(signal.SIGKILL)
+                    node_b.wait(timeout=30)
+                    killed = True
+                r = failover.simulate(
+                    trace=fixture, arch=arch, overlays=list(overlays),
+                    tuned=False,
+                )
+                if served_bytes(r.stats) != golden_bytes(name):
+                    raise ValueError(
+                        f"cluster smoke [node-kill]: served stats for "
+                        f"{name} diverged after failover"
+                    )
+            if not killed:
+                raise ValueError("cluster smoke: kill leg never killed")
+
+            # the heal must be RECORDED: the reaper marks B dead, bumps
+            # the epoch, and the fleet gauges settle at one alive node
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                stats = a.cluster.stats_dict()
+                if (
+                    stats["cluster_deaths_total"] >= 1
+                    and stats["cluster_nodes_alive"] == 1
+                ):
+                    break
+                time.sleep(0.2)
+            else:
+                raise ValueError(
+                    f"cluster smoke: node B's death was never recorded "
+                    f"({a.cluster.stats_dict()})"
+                )
+            summary.update({
+                "configs": configs,
+                "heal_epoch": a.cluster.epoch,
+                "deaths": a.cluster.stats_dict()["cluster_deaths_total"],
+            })
+        finally:
+            if not a.drain_and_stop():
+                raise ValueError("node A did not drain cleanly")
+
+        # -- leg 2: sharded campaign, chaos + resume, byte-identity ---
+        single = run_campaign(
+            CLUSTER_CAMPAIGN_SPEC,
+            trace_path=FIXTURES / CAMPAIGN_SMOKE_FIXTURE,
+            out_dir=f"{td}/single",
+        )
+        single_bytes = Path(f"{td}/single/report.json").read_text()
+
+        def kill_one_shard(procs):
+            """Watch the busiest shard's journal; SIGKILL its process
+            the moment a scenario record lands — mid-run by
+            construction, since its remaining scenarios are unpriced."""
+            if len(procs) < 2:
+                return
+
+            def watch(node, proc):
+                path = (
+                    Path(td) / "chaos" / "shards" / f"n{node}"
+                    / "journal.jsonl"
+                )
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline and proc.is_alive():
+                    if path.is_file() and sum(
+                        1 for ln in path.read_bytes().splitlines()
+                        if b'"scenario"' in ln
+                    ) >= 1:
+                        os.kill(proc.pid, signal.SIGKILL)
+                        return
+                    time.sleep(0.05)
+
+            node = sorted(procs)[0]
+            threading.Thread(
+                target=watch, args=(node, procs[node]), daemon=True,
+            ).start()
+
+        heals: list[str] = []
+        chaos = run_sharded_campaign(
+            CLUSTER_CAMPAIGN_SPEC,
+            trace_path=FIXTURES / CAMPAIGN_SMOKE_FIXTURE,
+            out_dir=f"{td}/chaos",
+            nodes=2,
+            progress=heals.append,
+            on_spawn=kill_one_shard,
+        )
+        chaos_bytes = Path(f"{td}/chaos/report.json").read_text()
+        if chaos_bytes != single_bytes:
+            raise ValueError(
+                "cluster smoke: shard-killed campaign report is not "
+                "byte-identical to the uninterrupted single-node run"
+            )
+        sigs, dup = _shard_journal_sigs(f"{td}/chaos")
+        if dup != 0:
+            raise ValueError(
+                f"cluster smoke: {dup} scenario(s) were re-priced "
+                f"after the shard kill (expected 0)"
+            )
+        if not any("died" in msg for msg in heals):
+            raise ValueError(
+                "cluster smoke: the shard SIGKILL was never observed "
+                "as a node death (kill landed after the shard "
+                "finished?)"
+            )
+
+        # coordinator killed mid-run, then --resume: the surviving
+        # journals are the durable record and nothing re-prices
+        spec_path = Path(td) / "spec.json"
+        spec_path.write_text(json.dumps(CLUSTER_CAMPAIGN_SPEC))
+        coord = subprocess.Popen(
+            [sys.executable, "-m", "tpusim", "campaign", str(spec_path),
+             "--trace", str(FIXTURES / CAMPAIGN_SMOKE_FIXTURE),
+             "--out", f"{td}/resume", "--nodes", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        deadline = time.monotonic() + 300.0
+        journaled = 0
+        while time.monotonic() < deadline and coord.poll() is None:
+            journaled, _ = _shard_journal_sigs(f"{td}/resume")
+            if journaled >= 1:
+                coord.send_signal(signal.SIGKILL)
+                break
+            time.sleep(0.05)
+        coord.wait(timeout=60)
+        done_before, _ = _shard_journal_sigs(f"{td}/resume")
+        if done_before < 1:
+            raise ValueError(
+                "cluster smoke: coordinator finished before the kill "
+                "landed — resume leg never exercised"
+            )
+        resumed = run_sharded_campaign(
+            CLUSTER_CAMPAIGN_SPEC,
+            trace_path=FIXTURES / CAMPAIGN_SMOKE_FIXTURE,
+            out_dir=f"{td}/resume",
+            nodes=2,
+            resume=True,
+        )
+        if Path(f"{td}/resume/report.json").read_text() != single_bytes:
+            raise ValueError(
+                "cluster smoke: resumed campaign report is not "
+                "byte-identical to the uninterrupted single-node run"
+            )
+        _, dup = _shard_journal_sigs(f"{td}/resume")
+        if dup != 0:
+            raise ValueError(
+                f"cluster smoke: --resume re-priced {dup} journaled "
+                f"scenario(s) (expected 0)"
+            )
+        rs = resumed.stats
+        if rs.resumed != done_before:
+            raise ValueError(
+                f"cluster smoke: resume restored {rs.resumed} "
+                f"scenario(s) from the shard journals, expected "
+                f"{done_before}"
+            )
+        summary.update({
+            "scenarios": chaos.stats.scenarios,
+            "shard_sigs": sigs,
+            "resumed": rs.resumed,
+        })
+        return summary
+    finally:
+        if node_b is not None and node_b.poll() is None:
+            node_b.kill()
+            node_b.wait(timeout=30)
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update", action="store_true",
@@ -2159,6 +2495,17 @@ def main(argv: list[str] | None = None) -> int:
                          "costing zero failed requests, and guard "
                          "deadline-504 / shared-quarantine semantics "
                          "holding across acceptors")
+    ap.add_argument("--cluster-smoke", action="store_true",
+                    help="multi-node cluster contract: the golden "
+                         "matrix byte-identical served single-node vs "
+                         "through both nodes of a 2-node localhost "
+                         "--join fleet (hot/compiled tiers engaged), "
+                         "one node SIGKILLed mid-matrix costing zero "
+                         "failed requests with the heal recorded, and "
+                         "--nodes 2 sharded campaigns (shard-killed "
+                         "and coordinator-killed-then-resumed) merging "
+                         "byte-identical to the single-node report "
+                         "with zero re-priced scenarios")
     ap.add_argument("--reqtrace-smoke", action="store_true",
                     help="request-tracing contract over a 2-acceptor "
                          "front: tracing off = byte-identical goldens "
@@ -2209,6 +2556,27 @@ def main(argv: list[str] | None = None) -> int:
                          "and the healthy golden matrix must be "
                          "untouched")
     args = ap.parse_args(argv)
+
+    if args.cluster_smoke:
+        try:
+            summary = cluster_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --cluster-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --cluster-smoke: OK "
+              f"({summary['configs']} configs byte-identical to CLI "
+              f"goldens single-node AND through both nodes of the "
+              f"2-node fleet; node SIGKILL mid-matrix cost zero failed "
+              f"requests, heal recorded at epoch "
+              f"{summary['heal_epoch']} with {summary['deaths']:.0f} "
+              f"death(s); sharded campaigns "
+              f"({summary['scenarios']:.0f} scenarios, "
+              f"{summary['shard_sigs']} journal signatures) stayed "
+              f"byte-identical to the single-node report through a "
+              f"shard kill and a coordinator kill + --resume "
+              f"({summary['resumed']} restored), zero re-priced "
+              f"scenarios)")
+        return 0
 
     if args.reqtrace_smoke:
         try:

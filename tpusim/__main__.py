@@ -515,7 +515,9 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
     capacity answer.  Crash-safe: re-run with --resume to continue a
     killed campaign from its last journaled scenario."""
     from tpusim.analysis import ValidationError
-    from tpusim.campaign import JournalError, run_campaign
+    from tpusim.campaign import (
+        JournalError, run_campaign, run_sharded_campaign,
+    )
     from tpusim.guard.cancel import CancelToken, OperationCancelled
 
     progress = None
@@ -525,18 +527,37 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
     cancel = None
     if getattr(args, "max_wall_s", None):
         cancel = CancelToken.after(args.max_wall_s)
+    nodes = getattr(args, "nodes", None)
+    if nodes is not None and nodes > 1 and not args.out:
+        print("tpusim campaign: --nodes needs --out DIR (the per-node "
+              "journal shards and merged report live there)",
+              file=sys.stderr)
+        return 2
     try:
-        res = run_campaign(
-            args.spec,
-            trace_path=args.trace,
-            out_dir=args.out,
-            resume=args.resume,
-            result_cache=args.result_cache,
-            workers=args.workers,
-            progress=progress,
-            cancel=cancel,
-            compile_cache=args.compile_cache,
-        )
+        if nodes is not None and nodes > 1:
+            res = run_sharded_campaign(
+                args.spec,
+                trace_path=args.trace,
+                out_dir=args.out,
+                nodes=nodes,
+                resume=args.resume,
+                result_cache=args.result_cache,
+                workers=args.workers,
+                progress=progress,
+                compile_cache=args.compile_cache,
+            )
+        else:
+            res = run_campaign(
+                args.spec,
+                trace_path=args.trace,
+                out_dir=args.out,
+                resume=args.resume,
+                result_cache=args.result_cache,
+                workers=args.workers,
+                progress=progress,
+                cancel=cancel,
+                compile_cache=args.compile_cache,
+            )
     except OperationCancelled as e:
         hint = (
             f"re-run with --resume --out {args.out} to continue from "
@@ -814,6 +835,8 @@ def _cmd_serve_front(args: argparse.Namespace) -> int:
         "trace_requests": args.trace_requests,
         "access_log": args.access_log,
         "quarantine_dir": quarantine_dir,
+        "join_addr": args.join,
+        "join_min_nodes": args.cluster_min_nodes,
     }
     front = FrontSupervisor(
         settings, num_acceptors=args.acceptors,
@@ -827,10 +850,11 @@ def _cmd_serve_front(args: argparse.Namespace) -> int:
     front.install_signal_handlers()
     mode = "SO_REUSEPORT" if reuse_port_available() else "fd-passing"
     hot_note = ", hot-cache on" if args.hot_cache else ""
+    join_note = f", join {args.join}" if args.join else ""
     print(f"tpusim serve: listening on http://{front.host}:{front.port} "
           f"(traces: {args.trace_root or 'inline only'}; "
           f"acceptors {args.acceptors} via {mode}"
-          f"{hot_note})",
+          f"{hot_note}{join_note})",
           flush=True)
     front.wait_stopped()
     if ephemeral_quarantine is not None:
@@ -877,6 +901,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             strict_lint=args.strict_lint,
             trace_requests=args.trace_requests,
             access_log=args.access_log,
+            cluster_join=args.join,
+            cluster_min_nodes=args.cluster_min_nodes,
         )
     except ValueError as e:
         # a quota/size typo must refuse loudly, not bound nothing
@@ -891,10 +917,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         f", serve-workers {args.serve_workers}" if args.serve_workers
         else ""
     )
+    join_note = f", join {args.join}" if args.join else ""
     print(f"tpusim serve: listening on http://{daemon.host}:{daemon.port} "
           f"(traces: {args.trace_root or 'inline only'}; "
           f"max-inflight {args.max_inflight}, queue {args.queue_depth}"
-          f"{workers_note})",
+          f"{workers_note}{join_note})",
           flush=True)
     daemon.wait_stopped()
     print("tpusim serve: drained, exiting", flush=True)
@@ -1632,6 +1659,15 @@ def main(argv: list[str] | None = None) -> int:
                           "campaign over an already-compiled trace "
                           "parses and compiles nothing "
                           "(tpusim.fastpath.store)")
+    pcm.add_argument("--nodes", type=int, default=None, metavar="N",
+                     help="shard scenario batches across N node "
+                          "processes by journal signature (requires "
+                          "--out): each shard appends to its own "
+                          "fsync'd journal, the coordinator merges by "
+                          "(slice, index) into a report byte-identical "
+                          "to a single-node run; a killed shard "
+                          "resumes ELSEWHERE with --resume, re-pricing "
+                          "nothing")
     pcm.add_argument("--max-wall-s", type=float, default=None, metavar="S",
                      help="cooperative wall-clock budget: the campaign "
                           "cancels at the next scenario boundary with "
@@ -1814,6 +1850,21 @@ def main(argv: list[str] | None = None) -> int:
                           "each runs its own HTTP parse + admission, so "
                           "no single GIL touches every request "
                           "(default 0: one daemon process)")
+    psv.add_argument("--join", default=None, metavar="HOST:PORT",
+                     help="serve v4: join the multi-node cluster whose "
+                          "primary listens at HOST:PORT — this node "
+                          "registers, heartbeats (capped-backoff, "
+                          "seeded jitter), serves its consistent-hash "
+                          "share of trace affinity, and forwards "
+                          "misses one hop to the owner; omit on the "
+                          "primary (it materializes the registry on "
+                          "the first join it receives)")
+    psv.add_argument("--cluster-min-nodes", type=int, default=1,
+                     metavar="N",
+                     help="shed compute requests (503 + Retry-After) "
+                          "while fewer than N cluster members are "
+                          "alive — a degraded fleet heals instead of "
+                          "melting (default 1: never shed)")
     psv.add_argument("--hot-cache", nargs="?", const=True, default=None,
                      metavar="DIR",
                      help="serve v3: shared mmap hot-response cache "

@@ -139,6 +139,17 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/obs/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
+    # the multi-node cluster (PR 17, tpusim.serve.cluster): membership
+    # epoch + join/beat/death/stale-rejoin counters and the forwarding/
+    # shed accounting, exported on /metrics ONLY when the daemon is
+    # actually clustered (a registry materialized or `--join`
+    # succeeded) — the reqtrace_/guard_ discipline at node grain: a
+    # never-joined daemon's scrape is key-identical, pinned by test.
+    # The directory owner covers cluster.py, daemon.py, and front.py;
+    # the CLI plumbs --join and the CI cluster smoke asserts the heal.
+    "cluster_": (
+        "tpusim/serve/", "tpusim/__main__.py", "ci/check_golden.py",
+    ),
 }
 
 #: keys deliberately shared across surfaces, with the subsystems licensed

@@ -14,6 +14,12 @@ the shared engine-result cache (:mod:`~tpusim.campaign.runner` +
 :mod:`~tpusim.campaign.journal`), and distribution/capacity reports
 joining the power model (:mod:`~tpusim.campaign.report`).  Reached via
 ``python -m tpusim campaign`` and ``POST /v1/campaign``.
+
+``--nodes N`` (:mod:`~tpusim.campaign.shard`) shards a campaign across
+node processes by journal signature over the serve tier's consistent-
+hash ring and merges the per-node journal shards into a report
+byte-identical to a single-node run — node death mid-campaign resumes
+the dead shard elsewhere with zero re-priced scenarios.
 """
 
 from tpusim.campaign.journal import Journal, JournalError
@@ -24,6 +30,7 @@ from tpusim.campaign.runner import (
     run_campaign,
 )
 from tpusim.campaign.sample import sample_schedule_doc, scenario_rng
+from tpusim.campaign.shard import run_sharded_campaign, shard_assignment
 from tpusim.campaign.spec import (
     CampaignSpec,
     CampaignSpecError,
@@ -42,7 +49,9 @@ __all__ = [
     "load_campaign_spec",
     "percentile",
     "run_campaign",
+    "run_sharded_campaign",
     "sample_schedule_doc",
+    "shard_assignment",
     "scenario_rng",
     "spec_hash",
 ]

@@ -268,6 +268,7 @@ def run_campaign(
     sleep=time.sleep,
     cancel=None,
     compile_cache=None,
+    only=None,
 ) -> CampaignResult:
     """Execute one campaign end to end.
 
@@ -285,7 +286,16 @@ def run_campaign(
     :class:`tpusim.guard.OperationCancelled` with every completed
     scenario already journaled, so a later ``resume=True`` re-prices
     nothing that finished — the serve tier's ``DELETE /v1/jobs/<id>``
-    and the CLI's ``--max-wall-s`` both arrive here."""
+    and the CLI's ``--max-wall-s`` both arrive here.
+
+    ``only`` (a set of ``(slice_label, index)`` pairs) restricts the
+    run to ONE SHARD of the campaign: scenarios outside the set are
+    neither priced nor journaled nor counted, slices with no assigned
+    scenario are skipped entirely (healthy baselines price only where
+    needed — they are deterministic, so every shard that touches a
+    slice journals the identical row), and no report is built — the
+    shard coordinator (:mod:`tpusim.campaign.shard`) merges journals
+    by ``(slice, index)`` and builds the one true report itself."""
     from tpusim.ici.topology import torus_for
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.timing.config import load_config
@@ -367,6 +377,10 @@ def run_campaign(
     rows_by_slice: dict[str, list[dict]] = {}
     try:
         for sl in spec.slices(default_chips):
+            if only is not None and not any(
+                (sl.label, i) in only for i in range(spec.scenarios)
+            ):
+                continue
             if cancel is not None:
                 cancel.check()
             stats.slices += 1
@@ -404,6 +418,8 @@ def run_campaign(
                 # far stays durable; the raise reaches the caller with
                 # the journal closed (the finally below) and a later
                 # --resume re-prices nothing already completed
+                if only is not None and (sl.label, i) not in only:
+                    continue
                 if cancel is not None:
                     cancel.check()
                 stats.scenarios += 1
@@ -434,6 +450,15 @@ def run_campaign(
         if journal is not None:
             journal.close()
 
+    if only is not None:
+        # shard run: the journal IS the deliverable — a report built
+        # from one shard's rows would be a partial document wearing a
+        # complete document's name
+        return CampaignResult(
+            doc={}, stats=stats, out_dir=out_dir, report_path=None,
+            wall_seconds=time.perf_counter() - t0,
+            rows_by_slice=rows_by_slice,
+        )
     doc = build_report(
         spec=spec,
         spec_digest=digest,

@@ -115,6 +115,8 @@ class MemoryWatchdog:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._next_step = 0
+        # also gossiped cluster-wide on heartbeats: a shedding member
+        # is skipped by the affinity ring until it recovers
         self.shedding = False
         # counters (surfaced as guard_* stats / /metrics gauges)
         self.rss_last = 0

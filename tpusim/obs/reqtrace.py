@@ -181,7 +181,8 @@ class RequestTrace:
             except (TypeError, ValueError):
                 continue  # a malformed frame never fails the request
 
-    def finish(self, status: int, acceptor: int | None = None) -> dict:
+    def finish(self, status: int, acceptor: int | None = None,
+               node_id: str | None = None) -> dict:
         """Freeze the trace into its document (idempotent)."""
         if self._doc is not None:
             return self._doc
@@ -203,6 +204,11 @@ class RequestTrace:
             "acceptor": acceptor,
             "spans": spans,
         }
+        if node_id is not None:
+            # clustered daemons only — the single-node document stays
+            # byte-identical, and cross-node forwarded requests
+            # correlate in the flight recorder by node
+            doc["node_id"] = node_id
         if self.meta:
             doc["meta"] = dict(self.meta)
         self._doc = doc
@@ -328,8 +334,13 @@ class RequestTracer:
     into the route/phase histograms, and feeds the flight recorder."""
 
     def __init__(self, acceptor_index: int | None = None,
-                 keep_slowest: int = 8, keep_errors: int = 64):
+                 keep_slowest: int = 8, keep_errors: int = 64,
+                 node_id: str | None = None):
         self.acceptor_index = acceptor_index
+        # stamped late by a daemon that becomes clustered mid-life
+        # (the lazy-primary path); None keeps documents and lines
+        # byte-identical to the single-node format
+        self.node_id = node_id
         self.recorder = FlightRecorder(keep_slowest, keep_errors)
         self._route: dict[str, LatencyHistogram] = {}
         self._phase: dict[str, LatencyHistogram] = {}
@@ -343,7 +354,9 @@ class RequestTracer:
         """Finalize a trace: freeze, observe, record.  Idempotent via
         the trace's own frozen document."""
         already = tr._doc is not None
-        doc = tr.finish(status, acceptor=self.acceptor_index)
+        doc = tr.finish(
+            status, acceptor=self.acceptor_index, node_id=self.node_id,
+        )
         if already:
             return doc
         with self._lock:
@@ -392,8 +405,9 @@ class RequestTracer:
 
     def traces_doc(self, limit: int = 50) -> list[dict]:
         """Summaries of retained traces, slowest first."""
-        return [
-            {
+        out = []
+        for d in self.recorder.snapshot(limit):
+            summary = {
                 "trace_id": d["trace_id"],
                 "route": d["route"],
                 "status": d["status"],
@@ -401,8 +415,10 @@ class RequestTracer:
                 "acceptor": d.get("acceptor"),
                 "spans": len(d["spans"]),
             }
-            for d in self.recorder.snapshot(limit)
-        ]
+            if "node_id" in d:
+                summary["node_id"] = d["node_id"]
+            out.append(summary)
+        return out
 
     def get(self, trace_id: str) -> dict | None:
         return self.recorder.get(trace_id)
@@ -504,19 +520,22 @@ class AccessLog:
 
     def write(self, *, route: str, status: int, latency_ms: float,
               trace_id: str | None = None, tier: str | None = None,
-              acceptor: int | None = None) -> None:
-        line = json.dumps(
-            {
-                "ts_s": round(time.monotonic() - self._t0, 6),
-                "trace_id": trace_id or "",
-                "route": route,
-                "status": int(status),
-                "latency_ms": round(float(latency_ms), 4),
-                "tier": tier or "",
-                "acceptor": acceptor,
-            },
-            sort_keys=True,
-        )
+              acceptor: int | None = None,
+              node_id: str | None = None) -> None:
+        doc = {
+            "ts_s": round(time.monotonic() - self._t0, 6),
+            "trace_id": trace_id or "",
+            "route": route,
+            "status": int(status),
+            "latency_ms": round(float(latency_ms), 4),
+            "tier": tier or "",
+            "acceptor": acceptor,
+        }
+        if node_id is not None:
+            # clustered daemons only: unclustered lines stay
+            # byte-identical to the PR 16 format
+            doc["node_id"] = node_id
+        line = json.dumps(doc, sort_keys=True)
         with self._lock:
             if self._fh.closed:
                 return

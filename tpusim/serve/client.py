@@ -133,6 +133,7 @@ class ServeClient:
         backoff_max_s: float = 2.0,
         honor_retry_after: bool = False,
         retry_after_max_s: float = 30.0,
+        members: list[str] | None = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
@@ -158,6 +159,25 @@ class ServeClient:
             )
         self._host = parsed.hostname
         self._port = parsed.port or 80
+        #: cluster failover (serve v4): additional known members, tried
+        #: in rotation when the active endpoint refuses or resets a
+        #: SAFE request (idempotent, or bytes never finished sending).
+        #: The never-replay rules are untouched: a submission that
+        #: finished sending, or ANY timeout, never moves to another
+        #: node — failover only re-issues what plain retry already
+        #: could, just somewhere the connection still opens.
+        addrs = [(self._host, self._port)]
+        for url in members or []:
+            p = urllib.parse.urlsplit(url.rstrip("/"))
+            if p.scheme != "http" or p.hostname is None:
+                raise ValueError(
+                    f"members must be http://host:port, got {url!r}"
+                )
+            pair = (p.hostname, p.port or 80)
+            if pair not in addrs:
+                addrs.append(pair)
+        self._addrs = addrs
+        self._active = 0
         self._local = threading.local()
         # (pid, construction order) — distinct per client instance and
         # per process, yet stable for a given run's construction order,
@@ -170,13 +190,17 @@ class ServeClient:
         self, fresh: bool = False, timeout_s: float | None = None,
     ) -> http.client.HTTPConnection:
         t = self.timeout_s if timeout_s is None else float(timeout_s)
+        host, port = self._addrs[self._active]
         conn = getattr(self._local, "conn", None)
+        if conn is not None and (conn.host, conn.port) != (host, port):
+            # another thread's failover moved the active member since
+            # this thread cached its connection — follow it
+            conn.close()
+            conn = None
         if conn is None or fresh:
             if conn is not None:
                 conn.close()
-            conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=t,
-            )
+            conn = http.client.HTTPConnection(host, port, timeout=t)
             self._local.conn = conn
         elif conn.timeout != t:
             # per-call override on a warm keep-alive connection: the
@@ -218,6 +242,10 @@ class ServeClient:
         # as PR 5's client always did) nor sleeps (a backoff here would
         # tax every request after any idle gap)
         stale_budget = 1
+        # one free immediate hop per OTHER known member: a dead node's
+        # refused connection must not burn the caller's retry budget
+        # just to reach a survivor (retries=0 still fails over)
+        failover_budget = len(self._addrs) - 1
         while True:
             was_cached = getattr(self._local, "conn", None) is not None
             conn = self._conn(fresh=fresh, timeout_s=timeout_s)
@@ -266,6 +294,20 @@ class ServeClient:
                 ):
                     # a timeout is a real wait, never the stale case
                     stale_budget -= 1
+                    continue
+                if (
+                    retryable and failover_budget > 0
+                    and isinstance(e, ConnectionError)
+                    and not isinstance(e, TimeoutError)
+                ):
+                    # connection refused/reset on a SAFE request and
+                    # other cluster members are known: rotate to the
+                    # next one and re-issue there.  Timeouts never fail
+                    # over (the slow node may still be executing) and
+                    # non-idempotent requests that finished sending
+                    # were already excluded by `retryable`.
+                    failover_budget -= 1
+                    self._active = (self._active + 1) % len(self._addrs)
                     continue
                 if attempt >= self.retries or not retryable:
                     code = (

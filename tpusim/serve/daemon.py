@@ -80,6 +80,15 @@ from tpusim.serve.admission import (
     JobTable,
     Overloaded,
 )
+from tpusim.serve.cluster import (
+    DEFAULT_BEAT_INTERVAL_S,
+    DEFAULT_MISSED_BEATS,
+    FORWARD_HEADER,
+    StaleEpoch,
+    alive_members,
+    member_url,
+    ring_for,
+)
 from tpusim.serve.registry import TraceRegistry
 from tpusim.serve.supervisor import (
     CooperativeCancel,
@@ -234,6 +243,7 @@ class _Handler(BaseHTTPRequestHandler):
                     latency_ms=doc["total_ms"], trace_id=tr.trace_id,
                     tier=(doc.get("meta") or {}).get("tier"),
                     acceptor=d.acceptor_index,
+                    node_id=d.cluster_node_id,
                 )
             return self._finished_tid
         if self._finished_tid is not None:
@@ -249,7 +259,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
             d.access_log.write(
                 route=route, status=status, latency_ms=latency_ms,
-                acceptor=d.acceptor_index,
+                acceptor=d.acceptor_index, node_id=d.cluster_node_id,
             )
         return None
 
@@ -369,6 +379,11 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         path = path.rstrip("/") or "/"
         local = "scope=local" in query
+        if path.startswith("/v1/cluster/"):
+            # cluster control traffic is not user traffic (the
+            # /-/stats discipline at node grain): uncounted, untraced
+            self._cluster_get(path)
+            return
         # fleet-internal probes (/-/stats, ?scope=local merges) are not
         # traffic: counting them would inflate the fleet-summed request
         # counters by N-1 on every scrape/health poll
@@ -378,6 +393,8 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/healthz":
             if d.admission.draining:
                 self._send_json(503, {"status": "draining"})
+            elif d.cluster_active() and not local:
+                self._send_json(200, d.cluster_healthz())
             elif d.in_fleet and not local:
                 self._send_json(200, d.fleet_healthz())
             else:
@@ -390,10 +407,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # sum exactly to serve_requests_total (finalize is
                 # idempotent; _send_text reuses the frozen trace ID)
                 self._finalize(200)
-            text = (
-                d.fleet_metrics_text()
-                if d.in_fleet and not local else d.metrics_text()
-            )
+            if d.cluster_active() and not local:
+                text = d.cluster_metrics_text()
+            elif d.in_fleet and not local:
+                text = d.fleet_metrics_text()
+            else:
+                text = d.metrics_text()
             self._send_text(200, text, "text/plain; version=0.0.4")
         elif path == "/v1/debug/traces" or \
                 path.startswith("/v1/debug/traces/"):
@@ -424,8 +443,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802 - stdlib signature
         d = self.daemon_obj
-        d._count("serve_requests_total")
         path = self.path.split("?", 1)[0].rstrip("/")
+        if path.startswith("/v1/cluster/"):
+            # joins + 1 Hz heartbeats are cluster-internal control
+            # traffic: counting them would make a clustered node's
+            # request counters diverge from a single node serving the
+            # same user load
+            self._cluster_post(path)
+            return
+        d._count("serve_requests_total")
         self._track(_post_route(path))
         if path == "/v1/simulate":
             d._count("serve_requests_simulate_total")
@@ -471,12 +497,23 @@ class _Handler(BaseHTTPRequestHandler):
                     tr.meta["tier"] = "hot"
                 self._send_body(200, blob)
                 return
+            # cluster trace affinity, AFTER the hot miss: a local hot
+            # hit is byte-identical wherever it is served, but a miss
+            # belongs at the key's owner, where the hot/compiled state
+            # for this trace concentrates
+            if deadline_ok and self._maybe_forward("simulate", path, body):
+                return
             self._run_sync(
                 "simulate", d.worker.simulate, body=body, hot_key=hot_key,
             )
         elif path == "/v1/lint":
             d._count("serve_requests_lint_total")
-            self._run_sync("lint", d.worker.lint)
+            body = self._read_body()
+            if body is None:
+                return
+            if self._maybe_forward("lint", path, body):
+                return
+            self._run_sync("lint", d.worker.lint, body=body)
         elif path in ("/v1/sweep", "/v1/campaign", "/v1/advise",
                       "/v1/fleet"):
             kind = path.rsplit("/", 1)[1]
@@ -619,10 +656,191 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, {"trace": doc})
 
-    def _proxy_to_primary(self, method: str, path: str, raw) -> None:
+    # -- cluster routes (tpusim.serve.cluster) -------------------------------
+
+    def _cluster_get(self, path: str) -> None:
+        d = self.daemon_obj
+        if path == "/v1/cluster/stats":
+            # cluster-internal: this NODE's raw values (acceptor-
+            # merged in front mode) — what peer nodes fold into their
+            # node-grain /metrics merge
+            self._send_json(200, {"values": d.node_stats_values()})
+            return
+        if path != "/v1/cluster/view":
+            self._send_json(404, {
+                "error": "unknown_route", "detail": f"no route {path!r}",
+            })
+            return
+        if d.in_fleet and not d.is_primary:
+            # the registry (and the member-side gossip cache) live on
+            # acceptor 0; secondaries forward like job routes do
+            self._proxy_to_primary("GET", path, None, counted=False)
+            return
+        view = d.cluster_view_doc()
+        if view is None:
+            self._send_json(404, {
+                "error": "no_cluster",
+                "detail": (
+                    "this node is not part of a cluster (start peers "
+                    "with --join pointing here, or --join one)"
+                ),
+            })
+            return
+        self._send_json(200, view)
+
+    def _cluster_post(self, path: str) -> None:
+        d = self.daemon_obj
+        if path not in ("/v1/cluster/join", "/v1/cluster/beat"):
+            self._send_json(404, {
+                "error": "unknown_route", "detail": f"no route {path!r}",
+            })
+            return
+        if d.in_fleet and not d.is_primary:
+            # single-writer epoch: only acceptor 0 mutates membership
+            try:
+                length = int(
+                    self.headers.get("Content-Length", "0") or 0
+                )
+            except ValueError:
+                length = 0
+            if length > d.max_request_bytes:
+                self.close_connection = True
+                self._send_json(413, {
+                    "error": "request_too_large",
+                    "detail": "cluster control bodies are small",
+                }, headers={"Connection": "close"})
+                return
+            raw = self.rfile.read(length) if length > 0 else b""
+            self._proxy_to_primary("POST", path, raw, counted=False)
+            return
+        body = self._read_body()
+        if body is None:
+            return
+        node_id = str(body.get("node_id") or "")
+        if not node_id:
+            self._send_json(400, {
+                "error": "bad_request", "detail": "node_id is required",
+            })
+            return
+        try:
+            epoch = int(body.get("epoch") or 0)
+        except (TypeError, ValueError):
+            self._send_json(400, {
+                "error": "bad_request", "detail": "epoch must be an int",
+            })
+            return
+        if path == "/v1/cluster/join":
+            reg = d.ensure_cluster_registry()
+            if reg is None:
+                # we are a member ourselves — point the joiner at OUR
+                # primary instead of forking a second epoch writer
+                self._send_json(409, {
+                    "error": "not_primary",
+                    "detail": (
+                        f"this node joined {d.cluster_join}; join the "
+                        f"primary there"
+                    ),
+                })
+                return
+            try:
+                view = reg.join(
+                    node_id, str(body.get("url") or ""), epoch,
+                )
+            except StaleEpoch as e:
+                self._send_json(409, {
+                    "error": "stale_epoch", "detail": str(e),
+                })
+                return
+        else:
+            reg = d.cluster
+            if reg is None:
+                # beats only make sense against a live registry; a
+                # restarted primary lost its table — members must
+                # rejoin fresh (409 is exactly that signal)
+                self._send_json(409, {
+                    "error": "no_cluster",
+                    "detail": "no registry here; rejoin with epoch 0",
+                })
+                return
+            try:
+                view = reg.beat(
+                    node_id, epoch,
+                    shedding=bool(body.get("shedding")),
+                )
+            except StaleEpoch as e:
+                self._send_json(409, {
+                    "error": "stale_epoch", "detail": str(e),
+                })
+                return
+        self._send_json(200, view)
+
+    def _maybe_forward(self, endpoint: str, path: str, body: dict) -> bool:
+        """Cluster trace affinity: when the affinity key's owner is
+        another alive node, forward the request there one-hop and relay
+        its bytes.  True when the response was sent here."""
+        d = self.daemon_obj
+        if self.headers.get(FORWARD_HEADER):
+            # already forwarded once: serve locally no matter what our
+            # ring says — the one-hop guarantee that kills routing
+            # loops during view skew
+            return False
+        target = d.cluster_owner_url(endpoint, body)
+        if target is None:
+            return False
+        return self._forward_to_node(target, path, body, endpoint)
+
+    def _forward_to_node(
+        self, url: str, path: str, body: dict, endpoint: str,
+    ) -> bool:
+        import http.client
+        from urllib.parse import urlsplit
+
+        d = self.daemon_obj
+        raw = json.dumps(body).encode()
+        tr = self._trace
+        try:
+            u = urlsplit(url)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=30.0,
+            )
+            headers = {
+                "Content-Type": "application/json",
+                FORWARD_HEADER: d.node_id,
+            }
+            if tr is not None:
+                headers[TRACE_HEADER] = tr.trace_id
+            conn.request("POST", path, body=raw, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            conn.close()
+        except (OSError, http.client.HTTPException):
+            # the owner is unreachable (dying, not yet reaped): serve
+            # locally — pricing is node-invariant, only cache locality
+            # suffers, and a request must never fail because the ring
+            # is mid-heal
+            d._count("cluster_forward_fallback_total")
+            return False
+        d._count("cluster_forwarded_total")
+        # the owner counted the forwarded copy as ITS request;
+        # compensate ours so node-summed totals count each user
+        # request exactly once (the _proxy_to_primary discipline)
+        d._count("serve_requests_total", -1.0)
+        d._count(f"serve_requests_{endpoint}_total", -1.0)
+        self._trace = None
+        self._route = None
+        if tr is not None:
+            self._relay_tid = resp.getheader(TRACE_HEADER) or tr.trace_id
+        self._send_body(resp.status, payload)
+        return True
+
+    def _proxy_to_primary(
+        self, method: str, path: str, raw, counted: bool = True,
+    ) -> None:
         """Forward one job-family request to the primary acceptor's
         direct listener (serve v3: the JobTable is single-owner).  The
-        primary's response travels back verbatim."""
+        primary's response travels back verbatim.  ``counted=False``
+        for cluster control routes, which never touched the request
+        counters."""
         import http.client
 
         d = self.daemon_obj
@@ -630,7 +848,8 @@ class _Handler(BaseHTTPRequestHandler):
         # primary will count the forwarded copy when it handles it —
         # without this compensation every proxied job request would
         # show as TWO requests in the fleet-summed /metrics
-        d._count("serve_requests_total", -1.0)
+        if counted:
+            d._count("serve_requests_total", -1.0)
         # the same rule governs tracing: drop this acceptor's trace
         # (never observed/recorded — the fleet histogram counts must
         # keep summing to serve_requests_total) and propagate its ID
@@ -712,6 +931,19 @@ class _Handler(BaseHTTPRequestHandler):
                 "detail": (
                     "daemon is over its --max-rss hard threshold and "
                     "shedding load; retry shortly"
+                ),
+            }, headers={"Retry-After": 2})
+            return
+        if d.cluster_shed():
+            # the watchdog ladder at node grain: too few alive nodes
+            # to absorb this load — shed instead of queueing work the
+            # survivors will only time out on
+            d._count("cluster_shed_total")
+            self._send_json(503, {
+                "error": "cluster_degraded",
+                "detail": (
+                    "alive cluster nodes are below the configured "
+                    "floor; retry after the fleet heals"
                 ),
             }, headers={"Retry-After": 2})
             return
@@ -881,6 +1113,10 @@ class ServeDaemon:
         worker_close_fds=(),
         trace_requests: bool = False,
         access_log=None,
+        cluster_join: str | None = None,
+        cluster_beat_s: float | None = None,
+        cluster_missed_beats: int | None = None,
+        cluster_min_nodes: int = 1,
     ):
         from pathlib import Path
 
@@ -917,6 +1153,30 @@ class ServeDaemon:
         self._peers: dict[int, int] = {}
         self.primary_direct: int | None = None
         self._peer_lock = threading.Lock()
+        # multi-node cluster (tpusim.serve.cluster): --join makes this
+        # daemon a member heartbeating a remote primary; a daemon
+        # started WITHOUT --join becomes a cluster primary lazily, on
+        # the first /v1/cluster/join it receives.  Until either
+        # happens the daemon carries zero cluster state and mints zero
+        # cluster stats keys — the single-node path stays key- and
+        # byte-identical by construction.
+        self.cluster_join = cluster_join or None
+        self.cluster_beat_s = float(
+            cluster_beat_s if cluster_beat_s is not None
+            else DEFAULT_BEAT_INTERVAL_S
+        )
+        self.cluster_missed_beats = int(
+            cluster_missed_beats if cluster_missed_beats is not None
+            else DEFAULT_MISSED_BEATS
+        )
+        self.cluster_min_nodes = max(int(cluster_min_nodes), 1)
+        self.cluster = None            # ClusterRegistry (primary side)
+        self.cluster_node_id = None    # stamped once clustered
+        self._cluster_view = None      # gossiped view (member side)
+        self._cluster_lock = threading.Lock()
+        self._heartbeat = None
+        self._reaper: threading.Thread | None = None
+        self._stop_cluster = threading.Event()
 
         # the process-wide shared result cache: always at least the
         # in-memory tier (sharing across requests IS the service's
@@ -1181,6 +1441,11 @@ class ServeDaemon:
         # tracing-off daemon's scrape and /-/stats are key-identical)
         if self.reqtrace is not None:
             values.update(self.reqtrace.metrics_values())
+        # cluster membership counters — registry-only (the single
+        # epoch writer is also their single stats writer); a
+        # never-clustered daemon's scrape stays key-identical
+        if self.cluster is not None:
+            values.update(self.cluster.stats_dict())
         return values
 
     @staticmethod
@@ -1275,12 +1540,21 @@ class ServeDaemon:
         "serve_uptime_s", "serve_hot_entries", "serve_hot_segment_bytes",
     })
 
-    def fleet_metrics_text(self) -> str:
-        """One fleet view: every live acceptor's values merged —
-        counters/gauges sum (an N-acceptor fleet's inflight capacity IS
-        the sum of its admission bounds), while uptime and the shared
-        hot-store gauges take the max, and ``serve_acceptors_alive`` /
-        ``_configured`` describe the fleet."""
+    @classmethod
+    def _merge_values(cls, merged: dict, vals: dict) -> None:
+        """Fold one peer's raw values into ``merged`` — counters sum,
+        the shared-resource gauges take the max."""
+        for k, v in vals.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if k in cls._FLEET_MAX_KEYS:
+                merged[k] = max(merged.get(k, 0.0), v)
+            else:
+                merged[k] = merged.get(k, 0.0) + v
+
+    def _merged_acceptor_values(self) -> tuple[dict, int]:
+        """This NODE's values: local plus every live peer acceptor's,
+        merged.  Returns ``(values, acceptors_alive)``."""
         merged = self.metrics_values()
         alive = 1
         for _idx, doc in self._fetch_peers_json("/-/stats").items():
@@ -1288,13 +1562,16 @@ class ServeDaemon:
             if not isinstance(vals, dict):
                 continue
             alive += 1
-            for k, v in vals.items():
-                if not isinstance(v, (int, float)):
-                    continue
-                if k in self._FLEET_MAX_KEYS:
-                    merged[k] = max(merged.get(k, 0.0), v)
-                else:
-                    merged[k] = merged.get(k, 0.0) + v
+            self._merge_values(merged, vals)
+        return merged, alive
+
+    def fleet_metrics_text(self) -> str:
+        """One fleet view: every live acceptor's values merged —
+        counters/gauges sum (an N-acceptor fleet's inflight capacity IS
+        the sum of its admission bounds), while uptime and the shared
+        hot-store gauges take the max, and ``serve_acceptors_alive`` /
+        ``_configured`` describe the fleet."""
+        merged, alive = self._merged_acceptor_values()
         merged["serve_acceptors_alive"] = alive
         merged["serve_acceptors_configured"] = self.acceptors_total
         return self._render_metrics(merged)
@@ -1359,6 +1636,183 @@ class ServeDaemon:
                 acceptors, key=lambda a: a.get("acceptor_index", -1)
             ),
         }
+
+    # -- multi-node cluster (tpusim.serve.cluster) ---------------------------
+
+    @property
+    def node_id(self) -> str:
+        """Cluster identity of this node: its public address.  Stable
+        across acceptor restarts (the fleet shares one public port) and
+        unique per box+port, which is all membership needs."""
+        return f"{self.host}:{self.port}"
+
+    def cluster_active(self) -> bool:
+        """True once this daemon is part of a cluster — as the lazy
+        primary (registry materialized) or as a joined member (a
+        gossiped view arrived)."""
+        return self.cluster is not None or self._cluster_view is not None
+
+    def cluster_view_doc(self) -> dict | None:
+        """The current membership view: authoritative on the primary,
+        the latest gossiped copy on a member, None unclustered."""
+        if self.cluster is not None:
+            return self.cluster.view()
+        return self._cluster_view
+
+    def _on_cluster_view(self, view: dict) -> None:
+        self._cluster_view = view
+
+    def ensure_cluster_registry(self):
+        """Materialize the primary-side registry on first join (None on
+        a member — it can never own the epoch).  Lazy on purpose: a
+        daemon nobody joins runs the exact single-node code paths and
+        mints zero cluster stats keys."""
+        if self.cluster_join is not None:
+            return None
+        with self._cluster_lock:
+            if self.cluster is None:
+                from tpusim.serve.cluster import ClusterRegistry
+
+                self.cluster = ClusterRegistry(
+                    self.node_id, self.url,
+                    beat_interval_s=self.cluster_beat_s,
+                    missed_beats=self.cluster_missed_beats,
+                )
+                self.cluster_node_id = self.node_id
+                if self.reqtrace is not None:
+                    self.reqtrace.node_id = self.node_id
+                self._reaper = threading.Thread(
+                    target=self._reap_loop,
+                    name="tpusim-cluster-reap", daemon=True,
+                )
+                self._reaper.start()
+            return self.cluster
+
+    def _reap_loop(self) -> None:
+        while not self._stop_cluster.wait(self.cluster_beat_s):
+            reg = self.cluster
+            if reg is None:
+                return
+            died = reg.reap()
+            if died and self.verbose:
+                print(
+                    f"tpusim serve: cluster marked dead: "
+                    f"{', '.join(died)} (epoch {reg.epoch})"
+                )
+
+    def _watchdog_shedding(self) -> bool:
+        return self.watchdog is not None and self.watchdog.shedding
+
+    def cluster_shed(self) -> bool:
+        """Node-grain load shed: with the alive-node count below the
+        configured floor, queueing more work onto the survivors only
+        converts overload into timeouts — answer 503 + Retry-After and
+        let the balancer back off until the fleet heals."""
+        if self.cluster_min_nodes <= 1:
+            return False
+        view = self.cluster_view_doc()
+        if view is None:
+            return False
+        return len(alive_members(view)) < self.cluster_min_nodes
+
+    def cluster_owner_url(self, endpoint: str, body: dict) -> str | None:
+        """Where a simulate/lint request's affinity key lives: the
+        owning node's public URL, or None when this node should serve
+        it (owner == self, ring too small, or no cluster).  The key is
+        the supervisor's volatile-stripped affinity hash, so cache
+        identity is node-invariant by construction."""
+        view = self.cluster_view_doc()
+        if view is None:
+            return None
+        ring = ring_for(view)
+        if len(ring) < 2:
+            return None
+        owner = ring.owner(Supervisor.affinity_key(endpoint, body))
+        if owner is None or owner == self.node_id:
+            return None
+        return member_url(view, owner)
+
+    def _fetch_node_json(self, url: str, path: str) -> dict | None:
+        """GET a peer NODE's ``path`` (public URL; the acceptor-grain
+        twin is :meth:`_fetch_peer_json` over direct ports)."""
+        import http.client
+        from urllib.parse import urlsplit
+
+        try:
+            u = urlsplit(url)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=0.8,
+            )
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            payload = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return None
+            return json.loads(payload)
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+
+    def node_stats_values(self) -> dict[str, float]:
+        """This NODE's raw metric values — acceptor-fleet-merged in
+        front mode, plain local otherwise.  Served on the
+        cluster-internal ``/v1/cluster/stats`` route so peers merge
+        node-grain numbers, never double-counting acceptors."""
+        if self.in_fleet:
+            merged, _alive = self._merged_acceptor_values()
+            return merged
+        return self.metrics_values()
+
+    def cluster_metrics_text(self) -> str:
+        """Node-grain ``/metrics``: every alive member's node-local
+        values merged (counters sum, shared-resource gauges max), plus
+        ``serve_nodes_alive`` / ``serve_nodes_configured``.  The
+        registry's own counters ride in via the primary's values —
+        exactly one writer per key across the whole cluster."""
+        view = self.cluster_view_doc() or {}
+        members = [
+            m for m in view.get("members", ()) if isinstance(m, dict)
+        ]
+        merged = self.node_stats_values()
+        alive = 1
+        for m in members:
+            if not m.get("alive") or m.get("node_id") == self.node_id:
+                continue
+            doc = self._fetch_node_json(
+                str(m.get("url")), "/v1/cluster/stats",
+            )
+            vals = (doc or {}).get("values")
+            if not isinstance(vals, dict):
+                continue
+            alive += 1
+            self._merge_values(merged, vals)
+        merged["serve_nodes_alive"] = alive
+        merged["serve_nodes_configured"] = max(len(members), 1)
+        return self._render_metrics(merged)
+
+    def cluster_healthz(self) -> dict:
+        """The node-grain ``/healthz``: the local (or acceptor-merged)
+        doc plus a cluster section; ``degraded`` while any configured
+        member is dead."""
+        doc = (
+            self.fleet_healthz() if self.in_fleet
+            else self.local_healthz()
+        )
+        view = self.cluster_view_doc() or {}
+        members = [
+            m for m in view.get("members", ()) if isinstance(m, dict)
+        ]
+        alive = sum(1 for m in members if m.get("alive"))
+        doc["cluster"] = {
+            "epoch": view.get("epoch"),
+            "node_id": self.node_id,
+            "primary": self.cluster is not None,
+            "nodes_alive": alive,
+            "nodes_configured": len(members),
+        }
+        if alive < len(members):
+            doc["status"] = "degraded"
+        return doc
 
     # -- hot-response tier (serve v3) ----------------------------------------
 
@@ -1683,6 +2137,21 @@ class ServeDaemon:
                 name="tpusim-serve-direct", daemon=True,
             )
             self._direct_thread.start()
+        if self.cluster_join is not None:
+            from tpusim.serve.cluster import HeartbeatLoop
+
+            # a --join member is clustered from boot; started here
+            # because node_id needs the BOUND public port
+            self.cluster_node_id = self.node_id
+            if self.reqtrace is not None:
+                self.reqtrace.node_id = self.node_id
+            self._heartbeat = HeartbeatLoop(
+                node_id=self.node_id, url=self.url,
+                join_addr=self.cluster_join,
+                interval_s=self.cluster_beat_s,
+                on_view=self._on_cluster_view,
+                shedding=self._watchdog_shedding,
+            ).start()
         for i in range(self._job_workers):
             t = threading.Thread(
                 target=self._job_loop, name=f"tpusim-serve-job-{i}",
@@ -1762,6 +2231,9 @@ class ServeDaemon:
         """The SIGTERM sequence: stop admitting, finish in-flight work
         and accepted jobs, flush the disk cache, close the listener.
         Returns True when everything drained inside the grace period."""
+        self._stop_cluster.set()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
         self.admission.start_drain()
         self.jobs.start_drain()
         clean = self.admission.wait_idle(self.drain_grace_s)
@@ -1790,6 +2262,9 @@ class ServeDaemon:
         emergency teardown): listener closed, job threads told to stop,
         queued/running jobs left exactly as persisted so a fresh daemon
         on the same ``state_dir`` recovers them."""
+        self._stop_cluster.set()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
         self._stop_jobs.set()
         for t in self._job_threads:
             t.join(timeout=2.0)

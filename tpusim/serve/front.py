@@ -133,6 +133,15 @@ def acceptor_main(index: int, conn, settings: dict) -> None:
         strict_lint=settings.get("strict_lint", False),
         trace_requests=settings.get("trace_requests", False),
         access_log=settings.get("access_log"),
+        # cluster membership is a PRIMARY concern: acceptor 0 owns the
+        # registry/heartbeat (the JobTable discipline); secondaries
+        # proxy /v1/cluster/* to it over the direct listener
+        cluster_join=(
+            settings.get("join_addr") if index == 0 else None
+        ),
+        cluster_min_nodes=(
+            settings.get("join_min_nodes", 1) if index == 0 else 1
+        ),
         acceptor_index=index,
         acceptors_total=settings.get("acceptors_total", 0),
         reuse_port=not fd_mode and bool(settings.get("reuse_port", True)),
