@@ -463,6 +463,200 @@ def dataflow_smoke() -> dict:
     }
 
 
+#: the TL501 seed: an async all-reduce that is ~100% exposed while an
+#: independent 1024^3 dot sits after the join — the engineered defect
+#: the perf-lint smoke requires BOTH front doors (lint --perf and
+#: perf-report) to flag
+PERF_LINT_TL501_HLO = """HloModule seeded501, is_scheduled=true, num_partitions=4
+
+%r (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (p0: f32[2097152], p1: f32[1024,1024]) -> f32[2097152] {
+  %p0 = f32[2097152]{0} parameter(0)
+  %p1 = f32[1024,1024]{1,0} parameter(1)
+  %st = f32[2097152]{0} all-reduce-start(%p0), channel_id=1, replica_groups={{0,1,2,3}}, to_apply=%r
+  %dn = f32[2097152]{0} all-reduce-done(%st)
+  %dot = f32[1024,1024]{1,0} dot(%p1, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[2097152]{0} add(%dn, %dn)
+}
+"""
+
+
+def perf_lint_smoke() -> dict:
+    """Perf-lint contract smoke (`tpusim.analysis` v3, the TL5xx
+    family):
+
+    1. every committed fixture trace + golden-matrix arch runs
+       ``lint --perf`` emitting the TL500 summary with ZERO TL5xx
+       errors — the opt-in passes must not refuse a healthy workload;
+    2. the three-way inequality holds per module per arch across the
+       full fixture + silicon corpus: critical path <= engine total
+       cycles <= serial op-cost sum, priced with the SAME composed
+       config, and every collective's exposed cycles <= its priced
+       cycles;
+    3. the seeded exposed-collective module trips TL501 through BOTH
+       front doors: ``analyze_trace_dir(perf=True)`` (what
+       ``lint --perf`` runs) and the ``tpusim perf-report`` CLI;
+    4. ``serve --strict-lint`` passes TL5xx findings through as
+       warnings — a verdict whose only warnings are TL5xx ADMITS the
+       trace;
+    5. the TL35x self-audit (now including the TL353 lock-across-fork
+       check) over the repo's own sources stays green.
+    Raises on violation."""
+    import subprocess
+    import tempfile
+
+    from tpusim.analysis import analyze_self_audit, analyze_trace_dir
+    from tpusim.analysis.critpath import analyze_module_perf
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace
+
+    fixtures = sorted({m[0] for m in MATRIX})
+    arches = sorted({m[1] for m in MATRIX})
+
+    # 1. healthy fixtures lint clean under --perf, with the summary
+    checked = 0
+    for fixture in fixtures:
+        for arch in arches:
+            diags = analyze_trace_dir(
+                FIXTURES / fixture, arch=arch, tuned=False, perf=True,
+            )
+            if "TL500" not in diags.codes():
+                raise ValueError(
+                    f"perf-lint smoke: {fixture}@{arch} emitted no "
+                    f"TL500 critical-path summary"
+                )
+            bad = [
+                d for d in diags.errors if d.code.startswith("TL5")
+            ]
+            if bad:
+                raise ValueError(
+                    f"perf-lint smoke: {fixture}@{arch} has TL5xx "
+                    f"errors on a healthy trace:\n"
+                    + "\n".join(d.text() for d in bad)
+                )
+            checked += 1
+
+    # 2. the inequality pin over the full corpus x matrix arches
+    corpus = [FIXTURES / f for f in fixtures]
+    silicon = REPO / "reports" / "silicon"
+    if silicon.is_dir():
+        corpus += sorted(
+            d for d in silicon.iterdir() if (d / "modules").is_dir()
+        )
+    bracketed = 0
+    for trace_dir in corpus:
+        pod = load_trace(trace_dir)
+        for arch in arches:
+            cfg = load_config(arch=arch, tuned=False)
+            for name, module in sorted(pod.modules.items()):
+                mp = analyze_module_perf(module, cfg)
+                eng = Engine(cfg).run(module).cycles
+                tol = 1e-6 * max(eng, 1.0)
+                if not (mp.critical_path_cycles <= eng + tol
+                        <= mp.serial_cycles + 2 * tol):
+                    raise ValueError(
+                        f"perf-lint smoke: inequality violated on "
+                        f"{trace_dir.name}/{name}@{arch}: critical "
+                        f"{mp.critical_path_cycles} vs engine {eng} "
+                        f"vs serial {mp.serial_cycles}"
+                    )
+                for cp in mp.comps.values():
+                    for e in cp.exposures:
+                        if e.exposed_cycles > e.priced_cycles + tol:
+                            raise ValueError(
+                                f"perf-lint smoke: {trace_dir.name}/"
+                                f"{name}@{arch} collective {e.op}: "
+                                f"exposed {e.exposed_cycles} > priced "
+                                f"{e.priced_cycles}"
+                            )
+                bracketed += 1
+
+    # 3. the seeded TL501 module trips through both front doors
+    with tempfile.TemporaryDirectory() as td:
+        trace = Path(td) / "seeded501"
+        (trace / "modules").mkdir(parents=True)
+        (trace / "modules" / "seeded501.hlo").write_text(
+            PERF_LINT_TL501_HLO
+        )
+        (trace / "meta.json").write_text(json.dumps(
+            {"num_devices": 4, "device_kind": "cpu"}
+        ))
+        (trace / "commandlist.jsonl").write_text(json.dumps(
+            {"kind": "kernel_launch", "module": "seeded501",
+             "device": 0}
+        ) + "\n")
+        diags = analyze_trace_dir(
+            trace, arch="v5e", tuned=False, perf=True,
+        )
+        if "TL501" not in diags.codes():
+            raise ValueError(
+                "perf-lint smoke: lint --perf missed the seeded "
+                "exposed collective:\n" + "\n".join(diags.text_lines())
+            )
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpusim", "perf-report",
+             str(trace), "--arch", "v5e"],
+            capture_output=True, text=True, timeout=300, cwd=REPO,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            raise ValueError(
+                f"perf-lint smoke: perf-report exited "
+                f"{proc.returncode} (warnings must not fail it): "
+                f"{proc.stderr[-1500:]}"
+            )
+        if "TL501" not in proc.stdout:
+            raise ValueError(
+                "perf-lint smoke: perf-report did not surface the "
+                "seeded TL501:\n" + proc.stdout[-1500:]
+            )
+
+    # 4. strict-lint serve admits TL5xx-only verdicts
+    from tpusim.serve.daemon import ServeDaemon
+    from tpusim.serve.client import ServeClient
+
+    with ServeDaemon(trace_root=FIXTURES, strict_lint=True) as d:
+        orig = d.worker.registry.trace_diagnostics
+
+        def fake(entry):
+            ds = orig(entry)
+            ds.emit("TL500", "critical path summary (synthetic)")
+            ds.emit("TL501", "collective 90% exposed (synthetic)")
+            return ds
+        d.worker.registry.trace_diagnostics = fake
+        r = ServeClient(d.url).simulate(trace="matmul_512", arch="v5e")
+        if not r.stats.get("sim_cycle", 0) > 0:
+            raise ValueError(
+                "perf-lint smoke: strict-lint serve failed to price "
+                "a trace whose only findings are TL5xx"
+            )
+        refused = d.worker.stats_dict()["strict_lint_refused_total"]
+        if refused:
+            raise ValueError(
+                f"perf-lint smoke: strict-lint serve refused "
+                f"{refused} TL5xx-only request(s) — TL5xx must pass "
+                f"through as warnings"
+            )
+
+    # 5. the self-audit (incl. TL353 lock-across-fork) stays green
+    audit = analyze_self_audit()
+    if audit.items:
+        raise ValueError(
+            "perf-lint smoke: TL35x self-audit is not clean:\n"
+            + "\n".join(audit.text_lines())
+        )
+    return {
+        "lint_cells": checked,
+        "modules_bracketed": bracketed,
+    }
+
+
 #: stats the perf/guard layers add only when active — stripped before
 #: golden comparison (the determinism contract covers the simulation
 #: stats, not the layers' own accounting)
@@ -2462,6 +2656,16 @@ def main(argv: list[str] | None = None) -> int:
                          "seeded two-device mismatched-collective "
                          "trace is refused, and the TL35x self-audit "
                          "over tpusim/ is green")
+    ap.add_argument("--perf-lint-smoke", action="store_true",
+                    help="perf-lint (TL5xx) contract: healthy fixtures "
+                         "emit the TL500 summary with no TL5xx errors, "
+                         "critical path <= engine <= serial sum holds "
+                         "per module per arch over the full corpus, "
+                         "the seeded exposed-collective trips TL501 "
+                         "from both lint --perf and perf-report, "
+                         "strict-lint serve admits TL5xx-only "
+                         "verdicts, and the TL35x self-audit stays "
+                         "green")
     ap.add_argument("--perf-smoke", action="store_true",
                     help="replay the golden matrix with --workers 4 and "
                          "an on-disk result cache: must match the "
@@ -2607,6 +2811,22 @@ def main(argv: list[str] | None = None) -> int:
               f"{summary['modules_agreed']} corpus modules, seeded "
               f"deadlock refused with {summary['deadlock_code']}, "
               f"TL35x self-audit green)")
+        return 0
+
+    if args.perf_lint_smoke:
+        try:
+            summary = perf_lint_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --perf-lint-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --perf-lint-smoke: OK "
+              f"({summary['lint_cells']} fixture/arch cells clean of "
+              f"TL5xx errors with TL500 summaries, critical path <= "
+              f"engine <= serial sum on "
+              f"{summary['modules_bracketed']} module/arch cells, "
+              f"seeded TL501 flagged by lint --perf AND perf-report, "
+              f"strict-lint serve admits TL5xx-only verdicts, "
+              f"self-audit green)")
         return 0
 
     if args.fleet_smoke:

@@ -32,9 +32,14 @@ import re
 import shutil
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+#: wall-clock start of the lint run, read by ``_json_doc`` so the
+#: ``--json`` report can carry how long the gate took (``duration_s``)
+_T0 = time.perf_counter()
 
 #: directories never linted (vendored/native/artifacts)
 EXCLUDE_DIRS = {
@@ -235,6 +240,7 @@ def _json_doc(
         "engine": engine,
         "count": len(findings),
         "findings": findings,
+        "duration_s": round(time.perf_counter() - _T0, 3),
     }
     if files is not None:
         doc["files"] = files
@@ -267,8 +273,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="force the stdlib checker even if ruff exists")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout (engine, "
-                         "count, findings); exit code unchanged")
+                         "count, findings, duration_s); exit code "
+                         "unchanged")
     args = ap.parse_args(argv)
+
+    global _T0
+    _T0 = time.perf_counter()
 
     ruff = shutil.which("ruff")
     if args.list:

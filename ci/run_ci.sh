@@ -122,15 +122,26 @@
 #                and coordinator killed then --resume'd — merging
 #                byte-identical to the uninterrupted single-node
 #                report with zero re-priced scenarios
-#  20. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  20. perflint — tpusim.analysis v3 perf-lint contract (TL5xx):
+#                healthy fixtures emit a TL500 critical-path summary
+#                and zero TL5xx errors across the arch matrix, the
+#                critical-path <= engine-cycles <= serial-op-sum
+#                inequality (and exposed <= priced per collective)
+#                holds on the full fixture + silicon corpus, a
+#                seeded exposed-collective trace trips TL501 through
+#                both `tpusim lint --perf` and `tpusim perf-report`,
+#                a strict-lint serve daemon admits TL5xx-only
+#                verdicts (advisory, never refusing), and the
+#                self-audit (now incl. TL353 fork-safety) is green
+#  21. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-19
+# Usage:  bash ci/run_ci.sh            # tiers 1-20
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/20] build native from source (+ native parity suite) ==="
+echo "=== [1/21] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -144,7 +155,7 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/20] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/21] repo static analysis (ruff / stdlib fallback) ==="
 lint_rc=0
 python ci/lint_repo.py --json > /tmp/tpusim_lint_repo.json || lint_rc=$?
 python - <<'PYEOF'
@@ -156,62 +167,65 @@ for f in doc["findings"]:
 PYEOF
 [[ "$lint_rc" == "0" ]] || exit "$lint_rc"
 
-echo "=== [3/20] unit tests (fast tier) ==="
+echo "=== [3/21] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/20] golden-stat regression sims ==="
+echo "=== [4/21] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/20] obs export smoke (schema-checked) ==="
+echo "=== [5/21] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/20] faults smoke (degraded-pod contract) ==="
+echo "=== [6/21] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/20] trace/config/schedule lint smoke ==="
+echo "=== [7/21] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/20] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/21] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/20] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
+echo "=== [9/21] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/20] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/21] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/20] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/21] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/20] front smoke (serve v3 multi-acceptor contract) ==="
+echo "=== [12/21] front smoke (serve v3 multi-acceptor contract) ==="
 python ci/check_golden.py --front-smoke
 
-echo "=== [13/20] reqtrace smoke (request-tracing + latency-histogram contract) ==="
+echo "=== [13/21] reqtrace smoke (request-tracing + latency-histogram contract) ==="
 python ci/check_golden.py --reqtrace-smoke
 
-echo "=== [14/20] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [14/21] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [15/20] advise smoke (sharding-advisor determinism) ==="
+echo "=== [15/21] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [16/20] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [16/21] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
-echo "=== [17/20] fleet smoke (digital-twin determinism) ==="
+echo "=== [17/21] fleet smoke (digital-twin determinism) ==="
 python ci/check_golden.py --fleet-smoke
 
-echo "=== [18/20] dataflow smoke (liveness/deadlock/self-audit contract) ==="
+echo "=== [18/21] dataflow smoke (liveness/deadlock/self-audit contract) ==="
 python ci/check_golden.py --dataflow-smoke
 
-echo "=== [19/20] cluster smoke (multi-node membership + distributed campaign chaos) ==="
+echo "=== [19/21] cluster smoke (multi-node membership + distributed campaign chaos) ==="
 python ci/check_golden.py --cluster-smoke
 
+echo "=== [20/21] perf-lint smoke (critical-path/TL5xx contract) ==="
+python ci/check_golden.py --perf-lint-smoke
+
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [20/20] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [21/21] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [20/20] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [21/21] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

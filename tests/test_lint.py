@@ -72,6 +72,16 @@ def _trace_defect(hlo: str, name: str = "bad", **kw):
     return build
 
 
+def _perf_defect(hlo: str, name: str = "bad", **kw):
+    """Trace defect analyzed with the opt-in TL50x perf passes on."""
+    def build(tmp_path: Path) -> Diagnostics:
+        return analyze_trace_dir(
+            make_trace(tmp_path, hlo=hlo, name=name, **kw),
+            arch="v5e", tuned=False, perf=True,
+        )
+    return build
+
+
 def _cmd_defect(commands=None, raw=None, meta=None):
     def build(tmp_path: Path) -> Diagnostics:
         return analyze_trace_dir(
@@ -479,6 +489,87 @@ ENTRY %main (p0: f32[8192,8192]) -> f32[8192,8192] {
             "        f.write('x')\n"
             "    os.replace(tmp, path)\n",
     })),
+    ("lock-across-fork", {"TL353"}, _selfaudit_defect({
+        "tpusim/serve/evil.py":
+            "import multiprocessing\n"
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "def spawn():\n"
+            "    ctx = multiprocessing.get_context('fork')\n"
+            "    with _lock:\n"
+            "        ctx.Process(target=None).start()\n",
+    })),
+    # TL50x: the opt-in perf passes (critical path / exposed
+    # communication over tpusim.analysis.critpath), each seeded with a
+    # module engineered on v5e to trip exactly one finding family.
+    ("perf-summary", {"TL500"}, _perf_defect(GOOD_HLO)),
+    ("exposed-collective", {"TL500", "TL501"}, _perf_defect(
+        # the async all-reduce is ~100% exposed while an independent
+        # 1024^3 dot sits AFTER the join — movable into its window
+        """HloModule tl501, is_scheduled=true, num_partitions=4
+
+%r (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (p0: f32[2097152], p1: f32[1024,1024]) -> f32[2097152] {
+  %p0 = f32[2097152]{0} parameter(0)
+  %p1 = f32[1024,1024]{1,0} parameter(1)
+  %st = f32[2097152]{0} all-reduce-start(%p0), channel_id=1, replica_groups={{0,1,2,3}}, to_apply=%r
+  %dn = f32[2097152]{0} all-reduce-done(%st)
+  %dot = f32[1024,1024]{1,0} dot(%p1, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[2097152]{0} add(%dn, %dn)
+}
+""")),
+    ("serialization-bubble", {"TL500", "TL502"}, _perf_defect(
+        # 'n' is a big kernel pinned behind a cheap convert tapped off
+        # the dot chain at d4: its other operand (p0) was ready at t=0,
+        # so it idles ~4 dot-widths; the chain through d12 keeps n off
+        # the critical path (on the path TL502 would be a TL500 story)
+        """HloModule tl502, is_scheduled=true
+
+ENTRY %main (p0: f32[512,512]) -> f32[512,512] {
+  %p0 = f32[512,512]{1,0} parameter(0)
+  %d1 = f32[512,512]{1,0} dot(%p0, %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d2 = f32[512,512]{1,0} dot(%d1, %d1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d3 = f32[512,512]{1,0} dot(%d2, %d2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d4 = f32[512,512]{1,0} dot(%d3, %d3), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %cv = f32[1]{0} convert(%d4)
+  %n = f32[512,512]{1,0} custom-call(%p0, %cv), custom_call_target="tpu_custom_call", backend_config={"custom_call_config": {"cost_estimate": {"flops": 5200000000, "transcendentals": 0, "bytes_accessed": 8192}}}
+  %d5 = f32[512,512]{1,0} dot(%d4, %d4), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d6 = f32[512,512]{1,0} dot(%d5, %d5), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d7 = f32[512,512]{1,0} dot(%d6, %d6), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d8 = f32[512,512]{1,0} dot(%d7, %d7), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d9 = f32[512,512]{1,0} dot(%d8, %d8), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d10 = f32[512,512]{1,0} dot(%d9, %d9), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d11 = f32[512,512]{1,0} dot(%d10, %d10), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %d12 = f32[512,512]{1,0} dot(%d11, %d11), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %out = f32[512,512]{1,0} add(%d12, %n)
+}
+""")),
+    ("hbm-dominated-path", {"TL500", "TL503"}, _perf_defect(
+        # cost_estimate claims 10 GiB of traffic against 8.6 GFLOP on
+        # an 8 MB shape: intensity 1024 flops/byte, far past the v5e
+        # ridge, yet the op prices HBM-bound and IS the critical path
+        """HloModule tl503, is_scheduled=true
+
+ENTRY %main (a: f32[1024,1024]) -> f32[1024,1024] {
+  %a = f32[1024,1024]{1,0} parameter(0)
+  ROOT %cc = f32[1024,1024]{1,0} custom-call(%a), custom_call_target="tpu_custom_call", backend_config={"custom_call_config": {"cost_estimate": {"flops": 8589934592, "transcendentals": 0, "bytes_accessed": 10737418240}}}
+}
+""")),
+    ("non-finite-cost", {"TL500", "TL504"}, _perf_defect(
+        # 1e999 overflows to inf in the cost_estimate parser — the
+        # analyzer must flag the poisoned op, not propagate NaN math
+        """HloModule tl504, is_scheduled=true
+
+ENTRY %main (a: f32[1024,1024]) -> f32[1024,1024] {
+  %a = f32[1024,1024]{1,0} parameter(0)
+  ROOT %cc = f32[1024,1024]{1,0} custom-call(%a), custom_call_target="tpu_custom_call", backend_config={"custom_call_config": {"cost_estimate": {"flops": 1e999, "transcendentals": 0, "bytes_accessed": 4096}}}
+}
+""")),
     ("statskey-ownership", {"TL301"}, _statskey_defect({
         "tpusim/timing/engine.py":
             'def stats_dict(self):\n'

@@ -389,6 +389,42 @@ def test_strict_lint_admits_clean_traces(warned_root):
         assert stats["lint_verdicts_cached"] == 1
 
 
+def test_strict_lint_passes_tl5xx_findings_as_warnings(warned_root):
+    """TL5xx perf-lint findings are advisory by contract: a verdict
+    whose only warnings are TL5xx must ADMIT the trace (the findings
+    still ride along in the cached doc), while a TL5xx finding next to
+    a genuine warning changes nothing about the refusal."""
+    def _with_perf_findings(registry):
+        orig = registry.trace_diagnostics
+
+        def fake(entry):
+            diags = orig(entry)
+            diags.emit("TL500", "critical path summary (synthetic)")
+            diags.emit("TL501", "collective 90% exposed (synthetic)")
+            return diags
+        registry.trace_diagnostics = fake
+
+    with ServeDaemon(trace_root=FIXTURES, strict_lint=True) as d:
+        _with_perf_findings(d.worker.registry)
+        c = ServeClient(d.url)
+        r = c.simulate(trace="matmul_512", arch="v5e")
+        assert r.stats["sim_cycle"] > 0
+        stats = d.worker.stats_dict()
+        assert stats["strict_lint_refused_total"] == 0
+        assert stats["lint_verdicts_cached"] == 1
+
+    with ServeDaemon(trace_root=warned_root, strict_lint=True) as d:
+        _with_perf_findings(d.worker.registry)
+        c = ServeClient(d.url)
+        with pytest.raises(ServeError) as ei:
+            c.simulate(trace="warned", arch="v5e")
+        assert ei.value.status == 422
+        # the perf findings ride along in the refusal doc unchanged
+        assert any(
+            item["code"] == "TL501" for item in ei.value.diagnostics
+        )
+
+
 def test_strict_lint_off_keeps_warning_traces_servable(warned_root):
     """The default daemon admits warning-only traces — strict lint is
     an opt-in tightening, not a behavior change."""

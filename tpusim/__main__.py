@@ -755,7 +755,8 @@ def _cmd_advise(args: argparse.Namespace) -> int:
           f"{cap['collective_sites']['ep']} ep collective sites")
     header = (f"  {'#':>3s} {'cell':26s} {'strategy':8s} "
               f"{'step_ms':>9s} {'ici_mb':>8s} {'coll':>5s} "
-              f"{'hbm_gib':>8s} {'watts':>7s} {'pf/W':>7s} flags")
+              f"{'hbm_gib':>8s} {'exp%':>6s} {'watts':>7s} "
+              f"{'pf/W':>7s} flags")
     print(header)
     shown = doc["cells"][: args.top] if args.top else doc["cells"]
     for r in shown:
@@ -769,10 +770,12 @@ def _cmd_advise(args: argparse.Namespace) -> int:
         w = f"{r['watts']:.1f}" if r["watts"] is not None else "-"
         pw = (f"{r['perf_per_watt']:.4f}"
               if r["perf_per_watt"] is not None else "-")
+        ef = r.get("exposed_comm_frac")
+        ef = f"{100.0 * ef:.1f}" if ef is not None else "-"
         print(f"  {r['rank']:3d} {r['cell']:26s} {r['strategy']:8s} "
               f"{r['step_ms']:9.4f} {r['ici_bytes'] / 1e6:8.2f} "
               f"{r['collectives_per_chip']:5d} "
-              f"{r['hbm_resident_gib']:8.4f} {w:>7s} {pw:>7s} "
+              f"{r['hbm_resident_gib']:8.4f} {ef:>6s} {w:>7s} {pw:>7s} "
               f"{','.join(flags) or 'ok'}")
     for s in doc["skipped"]:
         print(f"      {s['cell']:26s} skipped: {s['reason']}")
@@ -1027,17 +1030,20 @@ def _cmd_lint(args: argparse.Namespace) -> int:
               "--list-codes",
               file=sys.stderr)
         return 2
-    if args.trace is None and (args.faults or args.config or args.arch):
-        print("tpusim lint: --faults/--config/--arch need a trace dir "
-              "(the declared topology and capture meta come from it)",
+    if args.trace is None and (args.faults or args.config or args.arch
+                               or args.perf):
+        print("tpusim lint: --faults/--config/--arch/--perf need a trace "
+              "dir (the declared topology and capture meta come from it)",
               file=sys.stderr)
         return 2
 
     diags = Diagnostics()
+    perf_docs: list | None = [] if args.perf else None
     if args.trace is not None:
         analyze_trace_dir(
             args.trace, arch=args.arch, overlays=list(args.config or []),
-            faults=args.faults, diags=diags,
+            faults=args.faults, diags=diags, perf=args.perf,
+            perf_report=perf_docs,
         )
     if args.campaign or args.advise:
         default_chips = 1
@@ -1068,7 +1074,14 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         analyze_self_audit(diags=diags)
 
     if args.format == "json":
-        print(diags.to_json())
+        if perf_docs is not None:
+            # perf opt-in: the same document plus the per-module
+            # critical-path docs (byte-identical without --perf)
+            print(json.dumps(
+                {**diags.to_doc(), "perf": perf_docs}, indent=2,
+            ))
+        else:
+            print(diags.to_json())
     else:
         for line in diags.text_lines():
             print(line)
@@ -1077,6 +1090,80 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         args.strict and diags.count(Severity.WARNING) > 0
     )
     return 1 if gate else 0
+
+
+def _cmd_perf_report(args: argparse.Namespace) -> int:
+    """`tpusim perf-report TRACE` — the critical-path analyzer's ranked
+    exposed-collective and slack tables, one section per module, plus
+    any TL5xx findings (text or the raw perf document as JSON)."""
+    from tpusim.analysis import analyze_trace_dir
+    from tpusim.analysis.diagnostics import Diagnostics
+
+    diags = Diagnostics()
+    perf_docs: list = []
+    analyze_trace_dir(
+        args.trace, arch=args.arch, overlays=list(args.config or []),
+        diags=diags, perf=True, perf_report=perf_docs,
+    )
+    if args.module is not None:
+        perf_docs = [d for d in perf_docs if d["module"] == args.module]
+        if not perf_docs:
+            print(f"tpusim perf-report: no module {args.module!r} in "
+                  f"{args.trace}", file=sys.stderr)
+            return 2
+
+    if args.format == "json":
+        print(json.dumps(
+            {**diags.to_doc(), "perf": perf_docs}, indent=2,
+        ))
+        return 1 if diags.has_errors else 0
+
+    top = max(args.top, 1)
+    for doc in perf_docs:
+        print(f"== module {doc['module']} (entry {doc['entry']}) ==")
+        print(f"  critical path : {doc['critical_path_cycles']:>14.1f} cycles")
+        print(f"  serial bound  : {doc['serial_cycles']:>14.1f} cycles")
+        print(f"  exposed coll  : {doc['exposed_collective_cycles']:>14.1f}"
+              f" of {doc['collective_cycles']:.1f} priced cycles")
+        exposures = [
+            {**e, "comp": cname}
+            for cname, cdoc in doc["computations"].items()
+            for e in cdoc["exposures"]
+        ]
+        exposures.sort(key=lambda e: -e["exposed_cycles"])
+        if exposures:
+            print(f"  {'collective':28s} {'computation':20s} "
+                  f"{'exposed':>10s} {'priced':>10s} {'movable':>10s} mode")
+            for e in exposures[:top]:
+                mode = "sync" if e["sync"] else "async"
+                print(f"  {e['op'][:28]:28s} {e['comp'][:20]:20s} "
+                      f"{e['exposed_cycles']:>10.1f} "
+                      f"{e['priced_cycles']:>10.1f} "
+                      f"{e['movable_cycles']:>10.1f} {mode}")
+        rows = [
+            {**o, "comp": cname}
+            for cname, cdoc in doc["computations"].items()
+            for o in cdoc["ops"]
+        ]
+        rows.sort(key=lambda o: -o["cycles"])
+        if rows:
+            print(f"  {'op':28s} {'computation':20s} {'cycles':>10s} "
+                  f"{'slack':>10s} {'bound':>5s} crit")
+            for o in rows[:top]:
+                crit = "*" if o["critical"] else ""
+                print(f"  {o['op'][:28]:28s} {o['comp'][:20]:20s} "
+                      f"{o['cycles']:>10.1f} {o['slack']:>10.1f} "
+                      f"{o['bound']:>5s} {crit}")
+        print()
+    perf_lines = [
+        line for d, line in zip(diags.sorted_items(), diags.text_lines())
+        if d.code.startswith("TL5")
+    ]
+    if perf_lines:
+        print("findings:")
+        for line in perf_lines:
+            print(f"  {line}")
+    return 1 if diags.has_errors else 0
 
 
 def _cmd_info(args: argparse.Namespace) -> int:
@@ -1994,11 +2081,38 @@ def main(argv: list[str] | None = None) -> int:
                           "subsystems, os.replace without "
                           "fsync-before-replace staging); exit 1 on "
                           "findings")
+    pli.add_argument("--perf", action="store_true",
+                     help="also run the TL50x performance passes "
+                          "(critical path, slack, exposed-communication "
+                          "accounting) priced with the composed config; "
+                          "--format json carries the per-module "
+                          "critical-path document under a 'perf' key")
     pli.add_argument("--list-codes", action="store_true",
                      help="print the diagnostic registry grouped by "
                           "family with the owning pass module, and "
                           "exit")
     pli.set_defaults(fn=_cmd_lint)
+
+    ppr = sub.add_parser(
+        "perf-report",
+        help="static perf verdict for a trace: ranked exposed-collective "
+             "and slack tables from the critical-path analyzer, plus the "
+             "TL5xx diagnostics",
+    )
+    ppr.add_argument("trace", help="trace directory to analyze")
+    ppr.add_argument("--arch", default=None,
+                     help="config preset to price with (default: the "
+                          "arch the trace was captured on)")
+    ppr.add_argument("--config", action="append",
+                     help="overlay flag file(s), applied like simulate's")
+    ppr.add_argument("--module", default=None,
+                     help="report only this module (default: all)")
+    ppr.add_argument("--top", type=int, default=10,
+                     help="rows per ranked table (default 10)")
+    ppr.add_argument("--format", choices=["text", "json"],
+                     default="text",
+                     help="text tables or the raw perf document")
+    ppr.set_defaults(fn=_cmd_perf_report)
 
     pi = sub.add_parser("info", help="describe a stored trace")
     pi.add_argument("trace")

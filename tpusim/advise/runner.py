@@ -157,6 +157,47 @@ def _residency_gib(module) -> float:
     return analyze_module(module).peak_live("hbm") / float(1 << 30)
 
 
+def _exposed_comm_frac(
+    compute, cfg, topo, cell_pod, step_cycles: float,
+    module_exposed: float | None = None,
+) -> float:
+    """Fraction of the cell's step cycles that are exposed (uncovered)
+    communication — the critical-path analyzer's
+    ``exposed_collective_cycles`` of the EXACT scaled module this cell
+    prices (same discipline as the hbm column: the ranked table and
+    ``analyze_module_perf`` can never disagree), plus the synthesized
+    standalone COLLECTIVE commands on device 0, which serialize on the
+    stream clock and are therefore fully exposed, priced through the
+    same collective model the driver uses.
+
+    Today's transform strips in-module collectives from the scaled
+    clone (``scaled_module``), so the module term is zero and the
+    synthesized commands carry all the communication; the module term
+    keeps the column correct the day the transform preserves them."""
+    from tpusim.analysis.critpath import analyze_module_perf
+    from tpusim.ici.detailed import make_collective_model
+    from tpusim.ir import CommandKind
+
+    if step_cycles <= 0:
+        return 0.0
+    if module_exposed is None:
+        module_exposed = analyze_module_perf(
+            compute, cfg, topology=topo,
+        ).exposed_collective_cycles
+    coll = make_collective_model(topo, cfg.arch.ici)
+    launches = 0
+    cmd_cycles = 0.0
+    for c in cell_pod.devices[0].commands:
+        if c.kind == CommandKind.KERNEL_LAUNCH:
+            launches += 1
+        elif c.kind == CommandKind.COLLECTIVE and c.collective is not None:
+            cmd_cycles += cfg.arch.seconds_to_cycles(
+                coll.seconds(c.collective, float(c.nbytes))
+            )
+    exposed = module_exposed * max(launches, 1) + cmd_cycles
+    return exposed / step_cycles
+
+
 def run_advise(
     spec_src,
     trace_path: str | Path | None = None,
@@ -228,6 +269,9 @@ def run_advise(
 
     cfg_cache: dict[str, object] = {}
     module_cache: dict[tuple[str, float], object] = {}
+    # scaled-module exposed-collective cycles, memoized per
+    # (module variant, arch) — analyze_module_perf is pure
+    perf_cache: dict[tuple, float] = {}
     rows: list[dict] = []
     skipped: list[dict] = []
     for cell in cells:
@@ -300,6 +344,18 @@ def run_advise(
             energy = report.power.total_joules
         resident_gib = _residency_gib(compute)
         fits_hbm = resident_gib <= cfg.arch.hbm_gib
+        pkey = (mkey, cell.sl.arch)
+        module_exposed = perf_cache.get(pkey)
+        if module_exposed is None:
+            from tpusim.analysis.critpath import analyze_module_perf
+
+            module_exposed = perf_cache[pkey] = analyze_module_perf(
+                compute, cfg, topology=topo,
+            ).exposed_collective_cycles
+        exposed_frac = _exposed_comm_frac(
+            compute, cfg, topo, cell_pod, report.cycles,
+            module_exposed=module_exposed,
+        )
         slo_ok = (
             None if spec.slo is None
             else step_ms <= spec.slo.step_time_ms
@@ -318,6 +374,7 @@ def run_advise(
             "collectives_per_chip": coll_per_chip,
             "hbm_resident_gib": resident_gib,
             "fits_hbm": fits_hbm,
+            "exposed_comm_frac": exposed_frac,
             "watts": watts,
             "pod_watts": (
                 watts * cell.sl.chips if watts is not None else None

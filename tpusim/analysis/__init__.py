@@ -7,12 +7,15 @@ machine-readable JSON form.  Pass families: trace (syntax + dataflow
 over the whole-trace liveness engine in :mod:`~tpusim.analysis.
 dataflow`), config, schedule, campaign/advise/fleet specs, TL40x
 memory-capacity checks, TL41x cross-device collective-deadlock
-matching, the repo-level stats-key contract audit, and the TL35x
+matching, TL50x performance passes (critical path, per-op slack,
+exposed-communication accounting over :mod:`~tpusim.analysis.
+critpath`), the repo-level stats-key contract audit, and the TL35x
 determinism/durability self-audit of tpusim's own sources.  Reached
-four ways: the ``tpusim lint`` CLI, the opt-in ``simulate --validate``
-pre-flight, the serving tier (``serve --strict-lint`` content-hash-
-cached 422 refusals), and ``ci/check_golden.py --lint-smoke`` /
-``--dataflow-smoke``.
+five ways: the ``tpusim lint`` / ``tpusim perf-report`` CLIs, the
+opt-in ``simulate --validate`` pre-flight, the serving tier (``serve
+--strict-lint`` content-hash-cached 422 refusals — TL5xx pass through
+as warnings, never refusing), and ``ci/check_golden.py --lint-smoke``
+/ ``--dataflow-smoke`` / ``--perf-lint-smoke``.
 """
 
 from tpusim.analysis.diagnostics import (
@@ -27,6 +30,12 @@ from tpusim.analysis.diagnostics import (
 )
 from tpusim.analysis.advise_passes import analyze_advise_spec
 from tpusim.analysis.campaign_passes import analyze_campaign_spec
+from tpusim.analysis.critpath import (
+    CritBuilder,
+    ModulePerf,
+    analyze_module_perf,
+    module_perf_doc,
+)
 from tpusim.analysis.fleet_passes import analyze_fleet_spec
 from tpusim.analysis.runner import (
     ValidationError,
@@ -42,8 +51,10 @@ __all__ = [
     "CODES",
     "CODE_FAMILIES",
     "CodeInfo",
+    "CritBuilder",
     "Diagnostic",
     "Diagnostics",
+    "ModulePerf",
     "Severity",
     "STATS_NAMESPACES",
     "ValidationError",
@@ -51,10 +62,12 @@ __all__ = [
     "analyze_campaign_spec",
     "analyze_config",
     "analyze_fleet_spec",
+    "analyze_module_perf",
     "analyze_schedule",
     "analyze_self_audit",
     "analyze_stats_keys",
     "analyze_trace_dir",
     "family_of",
     "list_code_lines",
+    "module_perf_doc",
 ]

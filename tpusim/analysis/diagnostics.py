@@ -148,6 +148,8 @@ _code("TL350", _E, "unseeded global-RNG draw inside a seeded subsystem")
 _code("TL351", _E, "wall-clock read inside a seeded subsystem")
 _code("TL352", _E, "os.replace publish without fsync-before-replace "
                    "staging")
+_code("TL353", _E, "threading lock held across a fork/spawn point (the "
+                   "forked child inherits a locked lock)")
 
 # --- memory passes (TL40x) -------------------------------------------------
 _code("TL400", _E, "peak-live HBM bytes exceed the chosen arch's "
@@ -166,6 +168,18 @@ _code("TL412", _E, "a device never issues a collective its group is "
                    "blocked on (hang)")
 _code("TL413", _E, "byte-count disagreement between matched collective "
                    "participants")
+
+# --- perf passes (TL50x) ---------------------------------------------------
+_code("TL500", _I, "critical-path summary (length, bound mix, exposed "
+                   "collective cycles) for a priced computation")
+_code("TL501", _W, "collective mostly exposed while independently "
+                   "schedulable compute sits in its issue window")
+_code("TL502", _W, "serialization bubble: a dependency chain through a "
+                   "small op pins a large op off the critical path")
+_code("TL503", _W, "HBM-bound op dominates the critical path despite an "
+                   "arithmetic intensity above the arch ridge point")
+_code("TL504", _E, "cost model returned a non-finite or negative cost "
+                   "for a reachable op")
 
 
 @dataclass(frozen=True)
@@ -313,6 +327,7 @@ CODE_FAMILIES: tuple[tuple[str, str, str], ...] = (
     ("TL40", "memory passes", "tpusim/analysis/memory_passes.py"),
     ("TL41", "collective-matching passes",
      "tpusim/analysis/collective_passes.py"),
+    ("TL50", "perf passes", "tpusim/analysis/perf_passes.py"),
 )
 
 

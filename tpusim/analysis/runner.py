@@ -105,6 +105,8 @@ def analyze_trace_dir(
     topology=None,
     lenient: bool = True,
     diags: Diagnostics | None = None,
+    perf: bool = False,
+    perf_report: list | None = None,
 ) -> Diagnostics:
     """The combined pre-flight: trace passes + config passes (composed
     the way ``simulate`` would) + schedule passes when ``faults`` is
@@ -138,6 +140,15 @@ def analyze_trace_dir(
     # TL40x: the dataflow liveness summaries the trace passes just
     # built, judged against the composed arch's HBM/vmem capacities
     run_memory_passes(pt, cfg, diags)
+    if perf or perf_report is not None:
+        # TL50x: critical path / exposed communication, priced with the
+        # exact composed config the engine would use (opt-in: pricing
+        # every op costs real time on big traces)
+        from tpusim.analysis.perf_passes import run_perf_passes
+
+        run_perf_passes(
+            pt, cfg, diags, report=perf_report, topology=topology,
+        )
 
     if faults is not None:
         from tpusim.ici.topology import torus_for

@@ -23,7 +23,15 @@ store write path breaks the discipline.
 * **TL352** (error) — an ``os.replace`` publish whose function neither
   calls ``os.fsync`` nor a module-local staging helper that fsyncs
   (``_stage_write``-style) before the rename: a host crash could
-  replay a short-read record the durable tiers exist to rule out.
+  replay a short-read record the durable tiers exist to rule out;
+* **TL353** (error) — a ``threading.Lock``/``RLock`` held across a
+  fork/spawn point (``os.fork``, a ``multiprocessing`` ``Process``
+  ``.start()``) in the process-spawning tier (``tpusim/serve/`` —
+  the front, the supervisor, the cluster overlay).  Under the fork
+  start method the child inherits the lock in its LOCKED state with
+  no owner thread to release it, so its first acquire deadlocks
+  forever; the audit flags both ``with lock:`` bodies and
+  ``.acquire()``/``.release()`` windows that contain a spawn.
 
 **Allowlist pragma**: a finding is suppressed by
 ``# lint-allow: TL35x <reason>`` on the flagged line or the line above
@@ -43,6 +51,7 @@ from tpusim.analysis.diagnostics import Diagnostics
 
 __all__ = [
     "DURABLE_AUDIT_GLOBS",
+    "FORKSAFE_AUDIT_GLOBS",
     "SEEDED_SUBSYSTEM_GLOBS",
     "run_selfaudit_passes",
 ]
@@ -66,6 +75,13 @@ DURABLE_AUDIT_GLOBS = (
     "tpusim/**/*.py",
     "ci/*.py",
     "bench.py",
+)
+
+#: the tier that forks/spawns OS processes while also juggling
+#: threading locks — the serve daemon, front (multi-process acceptors),
+#: supervisor (worker children), and the cluster overlay all live here
+FORKSAFE_AUDIT_GLOBS = (
+    "tpusim/serve/*.py",
 )
 
 #: constructors/state plumbing on the stdlib ``random`` module that do
@@ -356,6 +372,158 @@ def _audit_durable_file(
     check_scope(tree)
 
 
+def _audit_forksafe_file(
+    rel: str, text: str, diags: Diagnostics,
+    allow: _Pragmas,
+) -> None:
+    """TL353: a threading lock held across a fork/spawn point.  Locks
+    are the names/attributes assigned ``threading.Lock()``/``RLock()``
+    anywhere in the file (the ``self._x_lock = threading.Lock()``
+    constructor idiom); spawn points are ``os.fork``/``forkpty`` and
+    ``.start()`` on a ``multiprocessing`` ``Process`` — direct, via a
+    ``get_context(...)`` handle, or chained ``ctx.Process(…).start()``.
+    Flagged when a spawn sits lexically inside a ``with lock:`` body or
+    between a lock's ``.acquire()`` and its ``.release()`` in the same
+    scope (nested function bodies audit as their own scopes — they run
+    later, not under this lock)."""
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError:
+        return
+
+    # pass 1 (file-wide): lock bindings + Process/context variables
+    lock_names: set[str] = set()
+    lock_attrs: set[str] = set()
+    ctx_names: set[str] = {"multiprocessing", "mp"}
+    proc_names: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        f = node.value.func
+        is_lock = (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("Lock", "RLock")
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"
+        ) or (isinstance(f, ast.Name) and f.id in ("Lock", "RLock"))
+        is_ctx = (
+            isinstance(f, ast.Attribute) and f.attr == "get_context"
+        ) or (isinstance(f, ast.Name) and f.id == "get_context")
+        is_proc = (
+            isinstance(f, ast.Attribute) and f.attr == "Process"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ctx_names
+        ) or (isinstance(f, ast.Name) and f.id == "Process")
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if is_lock:
+                    lock_names.add(t.id)
+                elif is_ctx:
+                    ctx_names.add(t.id)
+                elif is_proc:
+                    proc_names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                if is_lock:
+                    lock_attrs.add(t.attr)
+                elif is_proc:
+                    proc_names.add(t.attr)
+
+    def lock_key(e: ast.AST) -> str | None:
+        if isinstance(e, ast.Name) and e.id in lock_names:
+            return e.id
+        if isinstance(e, ast.Attribute) and e.attr in lock_attrs:
+            return f".{e.attr}"
+        return None
+
+    def spawn_desc(n: ast.AST) -> str | None:
+        for attr in ("fork", "forkpty"):
+            if _is_os_call(n, attr):
+                return f"os.{attr}()"
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "start":
+            base = n.func.value
+            if isinstance(base, ast.Name) and base.id in proc_names:
+                return f"{base.id}.start()"
+            if isinstance(base, ast.Attribute) and \
+                    base.attr in proc_names:
+                return f"{base.attr}.start()"
+            if isinstance(base, ast.Call) and \
+                    isinstance(base.func, ast.Attribute) and \
+                    base.func.attr == "Process":
+                return "Process(...).start()"
+        return None
+
+    def iter_scope(scope):
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def emit(lineno: int, key: str, desc: str) -> None:
+        if allow.allows("TL353", lineno):
+            return
+        diags.emit(
+            "TL353",
+            f"threading lock '{key.lstrip('.')}' is held across "
+            f"{desc} — under the fork start method the child "
+            f"inherits the lock LOCKED with no owner to release "
+            f"it and deadlocks on first acquire (spawn outside "
+            f"the lock, or document with "
+            f"'# lint-allow: TL353 <reason>')",
+            file=rel, line=lineno,
+        )
+
+    # ``with lock:`` bodies
+    for wnode in ast.walk(tree):
+        if not isinstance(wnode, (ast.With, ast.AsyncWith)):
+            continue
+        keys = [
+            k for k in (
+                lock_key(item.context_expr) for item in wnode.items
+            ) if k is not None
+        ]
+        if not keys:
+            continue
+        for stmt in wnode.body:
+            for sub in [stmt, *iter_scope(stmt)]:
+                d = spawn_desc(sub)
+                if d is not None:
+                    emit(sub.lineno, keys[0], d)
+
+    # ``.acquire()`` … spawn … ``.release()`` windows, per scope
+    scopes = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ] + [tree]
+    for scope in scopes:
+        events: list[tuple[int, str, str]] = []
+        for sub in iter_scope(scope):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("acquire", "release"):
+                k = lock_key(sub.func.value)
+                if k is not None:
+                    events.append((sub.lineno, sub.func.attr, k))
+                continue
+            d = spawn_desc(sub)
+            if d is not None:
+                events.append((sub.lineno, "spawn", d))
+        held: dict[str, int] = {}
+        for lineno, kind, what in sorted(events):
+            if kind == "acquire":
+                held[what] = lineno
+            elif kind == "release":
+                held.pop(what, None)
+            elif held:
+                key = next(iter(held))
+                emit(lineno, key, what)
+
+
 def run_selfaudit_passes(
     diags: Diagnostics, root: str | Path | None = None,
 ) -> None:
@@ -383,3 +551,11 @@ def run_selfaudit_passes(
         rel = path.relative_to(root).as_posix()
         text = path.read_text()
         _audit_durable_file(rel, text, diags, _Pragmas(text))
+
+    forksafe: list[Path] = []
+    for pat in FORKSAFE_AUDIT_GLOBS:
+        forksafe.extend(sorted(root.glob(pat)))
+    for path in forksafe:
+        rel = path.relative_to(root).as_posix()
+        text = path.read_text()
+        _audit_forksafe_file(rel, text, diags, _Pragmas(text))

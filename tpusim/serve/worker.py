@@ -328,9 +328,11 @@ class ServeWorker:
     def _strict_lint_gate(self, entry, inline: bool, req: dict) -> None:
         """``--strict-lint``: refuse (422 + the full diagnostics doc)
         any trace whose trace-family passes report errors OR warnings.
-        The verdict is cached by content hash, so a fleet lints each
-        distinct trace once; later submissions are admitted or refused
-        from the cache without re-walking a line."""
+        TL5xx perf-lint findings are exempt: they ride along in the
+        doc as advisory warnings but never refuse.  The verdict is
+        cached by content hash, so a fleet lints each distinct trace
+        once; later submissions are admitted or refused from the cache
+        without re-walking a line."""
         key = self._content_key(entry, inline, req)
         with self._lint_lock:
             doc = self._lint_verdicts.get(key)
@@ -358,7 +360,16 @@ class ServeWorker:
                     if oldest == key:
                         break
                     self._lint_verdicts.pop(oldest)
-        counts = doc.get("counts", {})
+        # TL5xx perf-lint findings are advisory by contract: they pass
+        # through in the cached doc for the caller to read but never
+        # refuse admission, so recount the gate's severities without
+        # them (the counts field keeps the full tally).
+        counts: dict = {}
+        for d in doc.get("diagnostics", []):
+            if str(d.get("code", "")).startswith("TL5"):
+                continue
+            sev = d.get("severity", "")
+            counts[sev] = counts.get(sev, 0) + 1
         if counts.get("error") or counts.get("warning"):
             with self._lint_lock:
                 self.strict_lint_refused += 1
