@@ -669,6 +669,79 @@ def _durable_cold_replay(
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
+def _scenario_batch_sweep(lanes: int = 48) -> dict | None:
+    """Scenario-batched pricing headline (PR 19): price a 48-scenario
+    degradation sweep of the llama_tiny_tp2dp2 fixture per-state
+    through the fastpath vs one lane-axis batch pass, best-of-3 each.
+    The batch contract is byte-identity (CI-pinned by check_golden
+    --fastpath-parity and tests/test_batch_price.py), so this leg only
+    measures speed: ``scenario_batch_kops_s`` is (module ops x lanes)
+    per batched host-second, ``speedup`` the honest ratio against the
+    SAME fastpath backend walked one state at a time."""
+    import timeit
+
+    from tpusim.fastpath import (
+        price_module_batch, resolve_backend, resolve_batch_backend,
+    )
+    from tpusim.fastpath.price import price_module
+    from tpusim.timing.config import load_config
+    from tpusim.timing.engine import Engine
+    from tpusim.trace.format import load_trace
+
+    trace_dir = (REPO_ROOT / "tests" / "fixtures" / "traces"
+                 / "llama_tiny_tp2dp2")
+    if not trace_dir.is_dir():
+        return None
+    backend = resolve_backend(None)
+    batch_backend = resolve_batch_backend(None)
+    if backend == "serial" or batch_backend == "serial":
+        return None  # no numpy column math: nothing to batch
+    pod = load_trace(trace_dir)
+    cfg = load_config(arch="v5p")
+    mod = next(iter(pod.modules.values()))
+    # the campaign-style launch classes: per-lane clock/HBM derates
+    engines = [
+        Engine(cfg, clock_scale=1.0 - 0.005 * (s % 16),
+               hbm_scale=1.0 - 0.007 * (s % 12))
+        for s in range(lanes)
+    ]
+    # compile once up front so both passes measure pricing alone
+    ref = price_module(engines[0], mod, backend)
+    price_module_batch(mod, engines)
+
+    def per_state():
+        for e in engines:
+            price_module(e, mod, backend)
+
+    def batched():
+        price_module_batch(mod, engines)
+
+    # the preceding bench legs leave allocator/GC pressure behind;
+    # collect first so best-of-N measures pricing, not their garbage
+    import gc
+
+    gc.collect()
+    # interleave the trials so co-tenant noise windows hit both sides
+    # equally; the batched pass is ~5x shorter, so give it 3 single-run
+    # samples per round — min() needs single runs (not averages) on
+    # both sides to find the same quiet-window floor
+    t_per = t_bat = float("inf")
+    for _ in range(5):
+        t_per = min(t_per, timeit.timeit(per_state, number=1))
+        t_bat = min(t_bat, *timeit.repeat(batched, number=1, repeat=3))
+    if t_bat <= 0 or t_per <= 0:
+        return None
+    return {
+        "scenario_batch_kops_s": round(
+            ref.op_count * lanes / t_bat / 1e3, 1),
+        "lanes": lanes,
+        "backend": batch_backend,
+        "per_state_ms": round(t_per * 1e3, 2),
+        "batched_ms": round(t_bat * 1e3, 2),
+        "speedup": round(t_per / t_bat, 2),
+    }
+
+
 def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     """Replay committed TPU traces against their committed measured times.
 
@@ -743,6 +816,20 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     except Exception as e:
         log(f"bench(fixture): durable-cold leg FAILED: "
             f"{type(e).__name__}: {e}")
+    # scenario-batched pricing leg (PR 19): the campaign/fleet regime —
+    # one module priced under a sweep of degradation launch classes
+    scenario_batch = None
+    try:
+        scenario_batch = _scenario_batch_sweep()
+        if scenario_batch is not None:
+            log(f"bench(fixture): scenario-batch x{scenario_batch['lanes']} "
+                f"per-state={scenario_batch['per_state_ms']:.1f}ms "
+                f"batched={scenario_batch['batched_ms']:.1f}ms "
+                f"speedup={scenario_batch['speedup']:.2f}x "
+                f"({scenario_batch['backend']})")
+    except Exception as e:
+        log(f"bench(fixture): scenario-batch leg FAILED: "
+            f"{type(e).__name__}: {e}")
     for name, sim_s, real_s, err, src, _fl, _hb, _ops in rows:
         # ground-truth provenance: entries captured before the
         # device-timeline change (or where the profiler failed) hold
@@ -799,6 +886,13 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
             sum(r[7] for r in rows) / durable_wall / 1e3, 1
         ) if durable_wall and rows else None,
         "compile_store": durable_stats,
+        # scenario-batched pricing (PR 19): kops/s through one lane-axis
+        # pass over the 48-scenario degradation sweep, with the honest
+        # per-state-fastpath baseline and speedup riding as detail
+        "scenario_batch_kops_s": (
+            scenario_batch["scenario_batch_kops_s"]
+            if scenario_batch else None),
+        "scenario_batch": scenario_batch,
         # which tpusim.fastpath backend priced (serial/vectorized/native)
         "pricing_backend": pricing_backend,
         # simulator throughput + cache effectiveness ride the artifact

@@ -1504,6 +1504,57 @@ def fastpath_smoke() -> dict:
     }
 
 
+def scenario_batch_smoke() -> dict:
+    """BATCHED leg of the fastpath-parity tier (PR 19): a 48-scenario
+    compound-fault campaign priced through the scenario-batched
+    lane-axis warm pass must produce a report document BYTE-identical
+    to the pure per-state walk, with the batch pass provably engaged
+    (``batch_stats.states`` — the ``fastpath_batched_states`` counter
+    — strictly positive).  The batching contract is "faster, not
+    different": the pass is only allowed to pre-fill the shared result
+    cache under the same per-state keys the serial walk mints."""
+    from tpusim.campaign import run_campaign
+
+    spec = {
+        "name": "ci-batch", "seed": 19, "scenarios": 48,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "faults": {
+            "count": {"dist": "uniform", "min": 0, "max": 2},
+            "kinds": {"link_down": 1.0, "link_degraded": 1.0,
+                      "chip_straggler": 0.5, "hbm_throttle": 0.5},
+            "scale": {"min": 0.4, "max": 0.9},
+        },
+    }
+    trace = FIXTURES / "llama_tiny_tp2dp2"
+    batched = run_campaign(dict(spec), trace_path=trace)
+    per_state = run_campaign(dict(spec), trace_path=trace,
+                             scenario_batch=False)
+    b_blob = json.dumps(batched.doc, sort_keys=True)
+    s_blob = json.dumps(per_state.doc, sort_keys=True)
+    if b_blob != s_blob:
+        raise ValueError(
+            "scenario-batched campaign report diverged from the "
+            "per-state walk — the batch byte-identity contract is "
+            "broken"
+        )
+    bs = batched.batch_stats
+    if bs is None or bs.states <= 0:
+        raise ValueError(
+            "batched campaign never engaged the lane-axis pass "
+            "(fastpath_batched_states == 0): the parity leg proved "
+            "nothing"
+        )
+    if per_state.batch_stats is not None:
+        raise ValueError(
+            "scenario_batch=False still constructed batch accounting"
+        )
+    return {
+        "scenarios": spec["scenarios"],
+        "batched_states": bs.states,
+        "batch_groups": bs.groups,
+    }
+
+
 def cold_serve_smoke() -> dict:
     """The durable tier's cold-path contract, end to end: a FRESH
     daemon process booted against a warm disk compile store must price
@@ -2857,6 +2908,12 @@ def main(argv: list[str] | None = None) -> int:
             print(f"ci/check_golden --fastpath-parity [cold-serve]: "
                   f"FAILED: {e}")
             return 1
+        try:
+            batch = scenario_batch_smoke()
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --fastpath-parity [batched]: "
+                  f"FAILED: {e}")
+            return 1
         print(f"ci/check_golden --fastpath-parity: OK "
               f"({summary['configs']} configs byte-identical across "
               f"backends {summary['backends']}; "
@@ -2866,7 +2923,11 @@ def main(argv: list[str] | None = None) -> int:
               f"with {summary['durable_store_hits']} store hits and "
               f"zero recompiles; cold-serve first request priced with "
               f"zero IR construction in "
-              f"{cold['cold_first_request_ms']:.0f}ms)")
+              f"{cold['cold_first_request_ms']:.0f}ms; "
+              f"{batch['scenarios']}-scenario campaign byte-identical "
+              f"batched vs per-state with "
+              f"{batch['batched_states']} lane(s) batch-priced in "
+              f"{batch['batch_groups']} group(s))")
         return 0
 
     if args.advise_smoke:

@@ -185,7 +185,7 @@ python ci/check_golden.py --lint-smoke
 echo "=== [8/21] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/21] fastpath parity (pricing-backend + durable-tier byte-identity) ==="
+echo "=== [9/21] fastpath parity (pricing-backend + durable-tier + scenario-batch byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
 echo "=== [10/21] serve smoke (HTTP daemon determinism, 1..N workers) ==="

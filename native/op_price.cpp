@@ -62,4 +62,36 @@ void op_price_scan(int64_t n,
   acc[6] = a_spill;
 }
 
+// Scenario-batched variant (tpusim/fastpath/batch.py): `lanes`
+// degradation states price one run through lane-major scans.  Only the
+// duration matrix is per-lane -- the counter columns are lane-INVARIANT
+// (the degrade transform never touches byte counts and the spill split
+// is a module-level fraction), so one pass over the shared columns
+// serves every lane.  Each lane is the scalar kernel's exact serial
+// chain, so lane s is byte-identical to an op_price_scan call seeded
+// with that lane's accumulators.
+//
+// Versioned separately from op_price_scan so a stale prebuilt library
+// degrades to the NumPy batch path instead of failing to load.
+int op_price_batch_abi_version() { return 1; }
+
+// dur: lanes*n lane-major; acc: lanes*7; t_before: lanes*n or null.
+void op_price_scan_batch(int64_t lanes,
+                         int64_t n,
+                         const double* dur,
+                         const double* flops,
+                         const double* mxu,
+                         const double* trans,
+                         const double* hbm,
+                         const double* vmem,
+                         const double* spilled,  // may be null
+                         double* acc,
+                         double* t_before) {     // may be null
+  for (int64_t s = 0; s < lanes; ++s) {
+    op_price_scan(n, dur + s * n, flops, mxu, trans, hbm, vmem, spilled,
+                  acc + s * 7,
+                  t_before ? t_before + s * n : nullptr);
+  }
+}
+
 }  // extern "C"

@@ -1,0 +1,323 @@
+"""Scenario-batched pricing (tpusim.fastpath.batch, PR 19).
+
+The batching contract is "faster, not different": one (ops x S)
+lane-axis pass over S degradation launch classes must produce
+EngineResults byte-identical to the per-state serial walk, populate the
+shared result cache under the SAME per-state keys that walk mints, and
+cancel cooperatively at batch grain.  Pinned here: corpus byte-identity
+across every available backend, single-lane degeneration, BatchStats
+engagement accounting, warm-cache interchangeability between modes
+(batched leg warms, per-state leg gets pure hits — and vice versa),
+cross-mode campaign resume (a cancelled batched leg resumed per-state
+matches the uninterrupted report byte-for-byte), and cancel at batch
+grain.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpusim.fastpath import (
+    native_batch_available,
+    numpy_available,
+    price_module_batch,
+    resolve_engine_scales,
+    warm_states,
+)
+from tpusim.fastpath.batch import BatchStats
+from tpusim.guard.cancel import CancelToken, OperationCancelled
+from tpusim.perf.cache import ResultCache, result_to_doc
+from tpusim.timing.config import load_config
+from tpusim.timing.engine import Engine
+from tpusim.trace.format import load_trace
+
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+TRACE = FIXTURES / "llama_tiny_tp2dp2"
+
+# the campaign-style launch classes: healthy + a derate ladder
+LANES = [(1.0, 1.0)] + [
+    (round(0.4 + 0.05 * i, 10), round(0.9 - 0.03 * i, 10))
+    for i in range(7)
+]
+
+
+def _jax_available() -> bool:
+    try:
+        from tpusim.fastpath.jax_backend import jax_price_available
+
+        return jax_price_available()
+    except Exception:  # noqa: BLE001 - probe only
+        return False
+
+
+BACKENDS = [
+    pytest.param(
+        "vectorized",
+        marks=pytest.mark.skipif(
+            not numpy_available(), reason="numpy not importable"),
+    ),
+    pytest.param(
+        "native",
+        marks=pytest.mark.skipif(
+            not native_batch_available(),
+            reason="native batch kernel not built"),
+    ),
+    pytest.param(
+        "jax",
+        marks=pytest.mark.skipif(
+            not _jax_available(), reason="jax not importable"),
+    ),
+]
+
+
+def _docs(results) -> list[str]:
+    return [
+        json.dumps(result_to_doc(r), sort_keys=False) for r in results
+    ]
+
+
+def _engines(cfg, lanes=LANES):
+    return [
+        Engine(cfg, clock_scale=cs, hbm_scale=hs) for cs, hs in lanes
+    ]
+
+
+# -- byte-identity ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("arch", ["v5e", "v5p"])
+def test_batched_matches_serial_walk_byte_identical(backend, arch):
+    """Every lane of every fixture module prices byte-identically to
+    the per-state serial reference walk, on every available backend."""
+    cfg = load_config(arch=arch)
+    for tdir in sorted(FIXTURES.iterdir()):
+        if not tdir.is_dir():
+            continue
+        pod = load_trace(tdir)
+        for _name, mod in sorted(pod.modules.items()):
+            engines = _engines(cfg)
+            serial = _docs([e._run_serial(mod) for e in engines])
+            batched = _docs(
+                price_module_batch(mod, engines, backend=backend)
+            )
+            assert batched == serial, f"{tdir.name}/{_name}"
+
+
+def test_single_lane_degenerates_to_per_state_fastpath():
+    """S=1 batching equals the per-state fastpath (and the serial
+    walk) for the same launch class — no special-casing."""
+    from tpusim.fastpath.price import price_module
+    from tpusim.fastpath import resolve_backend
+
+    cfg = load_config(arch="v5p")
+    pod = load_trace(TRACE)
+    mod = next(iter(pod.modules.values()))
+    eng = Engine(cfg, clock_scale=0.77, hbm_scale=0.91)
+    [batched] = price_module_batch(mod, [eng])
+    ref = price_module(
+        Engine(cfg, clock_scale=0.77, hbm_scale=0.91), mod,
+        resolve_backend(None),
+    )
+    assert _docs([batched]) == _docs([ref])
+
+
+def test_serial_backend_degenerates_to_per_lane_walk():
+    cfg = load_config(arch="v5e")
+    pod = load_trace(TRACE)
+    mod = next(iter(pod.modules.values()))
+    engines = _engines(cfg, LANES[:3])
+    batched = _docs(price_module_batch(mod, engines, backend="serial"))
+    serial = _docs([e._run_serial(mod) for e in _engines(cfg, LANES[:3])])
+    assert batched == serial
+
+
+def test_resolve_engine_scales_shared_helper():
+    """The hoisted scale-resolution helper matches what the engines
+    were constructed with (price.py and batch.py both consume it)."""
+    cfg = load_config(arch="v5p")
+    eng = Engine(cfg, clock_scale=0.5, hbm_scale=0.25)
+    clock, hbm = resolve_engine_scales(eng)
+    assert clock == 0.5 and hbm == 0.25
+
+
+# -- warm_states: cache keys + accounting + cancel --------------------------
+
+
+def _campaign_states(topo, n=4):
+    """A healthy state (None) + hand-built degradation schedules."""
+    from tpusim.faults import load_fault_schedule
+
+    docs = [
+        {"faults": [{"kind": "chip_straggler", "chip": 0,
+                     "clock_scale": 0.5 + 0.1 * i}]}
+        for i in range(n - 1)
+    ]
+    return [None] + [
+        load_fault_schedule(d).bind(topo) for d in docs
+    ]
+
+
+def test_warm_states_fills_per_state_cache_keys():
+    """warm_states mints the SAME keys the per-state walk asks for:
+    after a warm pass, pricing each state through the cache is a pure
+    hit, and the cached results are byte-identical to the walk's."""
+    from tpusim.ici.topology import torus_for
+
+    pod = load_trace(TRACE)
+    cfg = load_config(arch="v5p")
+    topo = torus_for(8, cfg.arch.name)
+    states = _campaign_states(topo)
+    cache = ResultCache()
+    stats = warm_states(pod, cfg, topo, states, cache)
+    assert stats.states > 0
+    assert stats.groups >= 1
+    assert stats.skipped == 0
+
+    # the per-state walk now finds every (module, scales, topo) key
+    misses_before = cache.misses
+    for state in states:
+        view = state.view_at(0.0) if state is not None else None
+        topo_k = topo.with_faults(view) if view is not None else topo
+        for dev_id in sorted(pod.devices):
+            scales = (view.chip_scales(dev_id)
+                      if view is not None else (1.0, 1.0))
+            for cmd in pod.devices[dev_id].commands:
+                mod = pod.modules.get(cmd.module)
+                if mod is None:
+                    continue
+                key = cache.key_for(mod, cfg, scales, topo_k)
+                if key is None:
+                    continue
+                hit = cache.get(key)
+                assert hit is not None, "warm pass missed a state key"
+                ref = Engine(
+                    cfg, topology=topo_k, clock_scale=scales[0],
+                    hbm_scale=scales[1],
+                )._run_serial(mod)
+                assert _docs([hit]) == _docs([ref])
+    assert cache.misses == misses_before
+
+    # re-warming is pure accounting: everything is already cached
+    stats2 = warm_states(pod, cfg, topo, states, cache)
+    assert stats2.states == 0
+    assert stats2.lanes_cached > 0
+
+
+def test_batch_stats_merge_and_keys():
+    a, b = BatchStats(), BatchStats()
+    a.states, a.groups = 3, 1
+    b.states, b.groups, b.lanes_cached, b.skipped = 2, 1, 4, 5
+    a.merge(b)
+    assert a.states == 5 and a.groups == 2
+    assert a.lanes_cached == 4 and a.skipped == 5
+    d = a.stats_dict()
+    assert d["fastpath_batched_states"] == 5.0
+    assert d["fastpath_batch_groups"] == 2.0
+    assert d["fastpath_batch_lanes_cached"] == 4.0
+    assert d["fastpath_batch_skipped"] == 5.0
+    assert all(isinstance(v, float) for v in d.values())
+
+
+def test_warm_states_cancels_at_batch_grain():
+    """A tripped token stops the warm pass with OperationCancelled
+    between batch grains — never a partial lane write."""
+    from tpusim.ici.topology import torus_for
+
+    pod = load_trace(TRACE)
+    cfg = load_config(arch="v5p")
+    topo = torus_for(8, cfg.arch.name)
+    states = _campaign_states(topo)
+    cache = ResultCache()
+    token = CancelToken()
+    token.cancel("test trip")
+    with pytest.raises(OperationCancelled):
+        warm_states(pod, cfg, topo, states, cache, cancel=token)
+    assert cache.hits == 0 and cache.misses == 0
+
+
+# -- campaign integration: cross-mode cache + resume ------------------------
+
+
+def _spec(**over) -> dict:
+    doc = {
+        "name": "batch-x", "seed": 11, "scenarios": 6,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "faults": {
+            "count": {"dist": "uniform", "min": 0, "max": 2},
+            "kinds": {"link_down": 1.0, "link_degraded": 1.0,
+                      "chip_straggler": 0.5, "hbm_throttle": 0.5},
+            "scale": {"min": 0.4, "max": 0.9},
+        },
+    }
+    doc.update(over)
+    return doc
+
+
+def test_campaign_modes_share_cache_without_repricing():
+    """A batched campaign leaves the shared result cache holding the
+    exact per-state keys a per-state campaign asks for: the second run
+    re-prices NOTHING and both reports are byte-identical."""
+    from tpusim.campaign import run_campaign
+
+    cache = ResultCache()
+    batched = run_campaign(
+        _spec(), trace_path=TRACE, result_cache=cache,
+    )
+    assert batched.batch_stats is not None
+    assert batched.batch_stats.states > 0
+    misses_before = cache.misses
+    per_state = run_campaign(
+        _spec(), trace_path=TRACE, result_cache=cache,
+        scenario_batch=False,
+    )
+    assert per_state.batch_stats is None
+    assert cache.misses == misses_before, (
+        "per-state walk re-priced states the batch pass should have "
+        "cached under identical keys"
+    )
+    assert (json.dumps(batched.doc, sort_keys=True)
+            == json.dumps(per_state.doc, sort_keys=True))
+
+
+def test_campaign_resume_across_modes(tmp_path):
+    """Leg 1 prices batched and is cancelled mid-campaign; leg 2
+    resumes with batching DISABLED and must complete to a report
+    byte-identical to an uninterrupted per-state run (and to an
+    uninterrupted batched run)."""
+    from tpusim.campaign import run_campaign
+
+    class Trip(CancelToken):
+        """Trips after N grain checks (cooperative, like --max-wall-s
+        but deterministic)."""
+
+        def __init__(self, after: int):
+            super().__init__()
+            self.n = 0
+            self.after = after
+
+        def check(self) -> None:
+            self.n += 1
+            if self.n == self.after:
+                self.cancel("test trip")
+            super().check()
+
+    out = tmp_path / "camp"
+    with pytest.raises(OperationCancelled):
+        run_campaign(
+            _spec(), trace_path=TRACE, out_dir=out, cancel=Trip(12),
+        )
+    resumed = run_campaign(
+        _spec(), trace_path=TRACE, out_dir=out, resume=True,
+        scenario_batch=False,
+    )
+    reference = run_campaign(_spec(), trace_path=TRACE,
+                             scenario_batch=False)
+    assert (json.dumps(resumed.doc, sort_keys=True)
+            == json.dumps(reference.doc, sort_keys=True))
+    batched_ref = run_campaign(_spec(), trace_path=TRACE)
+    assert (json.dumps(batched_ref.doc, sort_keys=True)
+            == json.dumps(reference.doc, sort_keys=True))

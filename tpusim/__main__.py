@@ -545,6 +545,8 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
                 workers=args.workers,
                 progress=progress,
                 compile_cache=args.compile_cache,
+                scenario_batch=(
+                    False if args.no_scenario_batch else None),
             )
         else:
             res = run_campaign(
@@ -557,6 +559,8 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
                 progress=progress,
                 cancel=cancel,
                 compile_cache=args.compile_cache,
+                scenario_batch=(
+                    False if args.no_scenario_batch else None),
             )
     except OperationCancelled as e:
         hint = (
@@ -605,6 +609,12 @@ def _cmd_campaign(args: argparse.Namespace) -> int:
               f"under sampled degradation: {best or 'NONE'}")
     for k, v in s.stats_dict().items():
         print(f"  {k} = {v:.0f}")
+    bs = getattr(res, "batch_stats", None)
+    if bs is not None and (bs.states or bs.lanes_cached or bs.skipped):
+        # only-when-active: batch accounting prints only when the
+        # lane-axis warm pass actually engaged this run
+        for k, v in bs.stats_dict().items():
+            print(f"  {k} = {v:.0f}")
     if res.report_path is not None:
         print(f"  report written to {res.report_path}")
     if args.json:
@@ -645,6 +655,8 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
             progress=progress,
             cancel=cancel,
             compile_cache=args.compile_cache,
+            scenario_batch=(
+                False if args.no_scenario_batch else None),
         )
     except OperationCancelled as e:
         hint = (
@@ -706,6 +718,12 @@ def _cmd_fleet(args: argparse.Namespace) -> int:
               f"{r['time_to_recover_s']:.1f}s")
     for k, v in s.stats_dict().items():
         print(f"  {k} = {v:.0f}")
+    bs = getattr(res, "batch_stats", None)
+    if bs is not None and (bs.states or bs.lanes_cached or bs.skipped):
+        # only-when-active: batch accounting prints only when the
+        # lane-axis warm pass actually engaged this run
+        for k, v in bs.stats_dict().items():
+            print(f"  {k} = {v:.0f}")
     if res.report_path is not None:
         print(f"  report written to {res.report_path}")
     if args.json:
@@ -1760,6 +1778,12 @@ def main(argv: list[str] | None = None) -> int:
                           "cancels at the next scenario boundary with "
                           "everything completed journaled — --resume "
                           "re-prices nothing (exit 3)")
+    pcm.add_argument("--no-scenario-batch", action="store_true",
+                     help="disable scenario-batched pricing (the "
+                          "lane-axis batch pass that warms the result "
+                          "cache per slice; report bytes are identical "
+                          "either way — this only trades speed for a "
+                          "pure per-state walk)")
     pcm.add_argument("--json", default=None,
                      help="also write the report document here")
     pcm.add_argument("--verbose", action="store_true",
@@ -1806,6 +1830,12 @@ def main(argv: list[str] | None = None) -> int:
                           "cancels at the next pricing/cell boundary "
                           "with everything priced so far journaled — "
                           "--resume re-prices nothing (exit 3)")
+    pfl.add_argument("--no-scenario-batch", action="store_true",
+                     help="disable scenario-batched pricing (the "
+                          "lane-axis batch pass that warms the result "
+                          "cache per pod; report bytes are identical "
+                          "either way — this only trades speed for a "
+                          "pure per-state walk)")
     pfl.add_argument("--json", default=None,
                      help="also write the report document here")
     pfl.add_argument("--verbose", action="store_true",

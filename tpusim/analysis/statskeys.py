@@ -94,7 +94,12 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
     # store is active (the cache_*/pool_* discipline: default
     # auto-fastpath runs stay key-identical, which is what keeps the
     # golden matrix byte-stable with the fastpath on); tpusim.serve
-    # mirrors the block on /metrics when the store is mounted
+    # mirrors the block on /metrics when the store is mounted.
+    # fastpath_batch* (PR 19): scenario-batched pricing accounting —
+    # minted exclusively by fastpath/batch.py BatchStats.stats_dict()
+    # and carried on CampaignResult/FleetResult.batch_stats (printed by
+    # the CLI only when a batch pass engaged); NEVER report bytes, so
+    # batched and per-state runs stay byte-identical by construction
     "fastpath_": (
         "tpusim/fastpath/", "tpusim/sim/driver.py", "tpusim/__main__.py",
         "tpusim/serve/", "bench.py", "ci/check_golden.py",

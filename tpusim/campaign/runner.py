@@ -83,6 +83,12 @@ class CampaignResult:
     report_path: Path | None = None
     wall_seconds: float = 0.0
     rows_by_slice: dict = field(default_factory=dict, repr=False)
+    #: scenario-batched pricing accounting
+    #: (:class:`tpusim.fastpath.batch.BatchStats`) when the warm phase
+    #: ran; None when batching was disabled.  Carried on the result
+    #: object only — report/journal bytes are the per-state walk's
+    #: either way (the batch publishes cache entries, nothing else).
+    batch_stats: object | None = None
 
 
 def _pod_devices(pod) -> int:
@@ -164,6 +170,49 @@ def _price(pod, cfg, topo, faults, cache, workers):
         watts = report.power.avg_watts
         energy = report.power.total_joules
     return cycles, step_s, watts, energy
+
+
+def _warm_slice(
+    spec: CampaignSpec, pod, cfg, topo, slice_label: str, indices,
+    cache, batch_stats, *, backend, cancel, replay_chips: int,
+    check_partition: bool,
+) -> None:
+    """Scenario-batched cache warm for one slice: re-sample every
+    pending scenario's schedule (pure substream functions — the rows
+    the scenario loop samples later are identical), drop the ones the
+    partition check will refuse anyway, and batch-price the remaining
+    degradation states' launch classes straight into the shared result
+    cache.  The per-scenario replays below then consume pure hits.
+
+    Strictly an optimization: any failure here (short of cooperative
+    cancellation, which must propagate) leaves the campaign to price
+    per-state exactly as if batching were off — journal and report
+    bytes are identical either way, pinned by the ``--fastpath-parity``
+    BATCHED leg."""
+    from tpusim.guard import OperationCancelled
+
+    try:
+        from tpusim.faults import load_fault_schedule
+        from tpusim.fastpath.batch import warm_states
+
+        states = []
+        for i in indices:
+            sched_doc = sample_schedule_doc(spec, topo, slice_label, i)
+            state = load_fault_schedule(sched_doc).bind(topo)
+            if check_partition and _schedule_partitions(
+                state, replay_chips
+            ):
+                continue  # becomes a partitioned row, never priced
+            states.append(state)
+        if states:
+            batch_stats.merge(warm_states(
+                pod, cfg, topo, states, cache,
+                backend=backend, cancel=cancel,
+            ))
+    except OperationCancelled:
+        raise
+    except Exception:  # noqa: BLE001 — warming must not fail a campaign
+        pass
 
 
 def _run_scenario(
@@ -269,6 +318,7 @@ def run_campaign(
     cancel=None,
     compile_cache=None,
     only=None,
+    scenario_batch: bool | str | None = None,
 ) -> CampaignResult:
     """Execute one campaign end to end.
 
@@ -295,7 +345,16 @@ def run_campaign(
     needed — they are deterministic, so every shard that touches a
     slice journals the identical row), and no report is built — the
     shard coordinator (:mod:`tpusim.campaign.shard`) merges journals
-    by ``(slice, index)`` and builds the one true report itself."""
+    by ``(slice, index)`` and builds the one true report itself.
+
+    ``scenario_batch`` controls the scenario-batched pricing fastpath
+    (:mod:`tpusim.fastpath.batch`): ``None``/``True`` (the default)
+    batch-warms each slice's pending degradation states into the
+    shared result cache before the scenario loop, ``False`` disables
+    it (the ``--no-scenario-batch`` flag), and a backend name from
+    ``BATCH_BACKENDS`` pins the batch backend.  Batching never changes
+    journal or report bytes — it only decides whether the per-scenario
+    replays price or hit the cache."""
     from tpusim.ici.topology import torus_for
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.timing.config import load_config
@@ -351,6 +410,11 @@ def run_campaign(
     }
 
     stats = CampaignStats()
+    batch_stats = None
+    if scenario_batch is not False:
+        from tpusim.fastpath.batch import BatchStats
+
+        batch_stats = BatchStats()
     cache = as_result_cache(result_cache) or ResultCache()
     # partition semantics need communicating chips: a pod with no
     # collectives has nothing to disconnect
@@ -403,6 +467,23 @@ def run_campaign(
                         "kind": "healthy", "slice": sl.label,
                         "row": healthy,
                     })
+            if batch_stats is not None:
+                pend = [
+                    i for i in range(spec.scenarios)
+                    if (only is None or (sl.label, i) in only)
+                    and (sl.label, i) not in completed
+                ]
+                if pend:
+                    _warm_slice(
+                        spec, pod, cfg, topo, sl.label, pend, cache,
+                        batch_stats,
+                        backend=(scenario_batch
+                                 if isinstance(scenario_batch, str)
+                                 else None),
+                        cancel=cancel,
+                        replay_chips=min(default_chips, topo.num_chips),
+                        check_partition=check_partition,
+                    )
             slices_doc.append({
                 "label": sl.label,
                 "arch": sl.arch,
@@ -458,6 +539,7 @@ def run_campaign(
             doc={}, stats=stats, out_dir=out_dir, report_path=None,
             wall_seconds=time.perf_counter() - t0,
             rows_by_slice=rows_by_slice,
+            batch_stats=batch_stats,
         )
     doc = build_report(
         spec=spec,
@@ -481,4 +563,5 @@ def run_campaign(
         doc=doc, stats=stats, out_dir=out_dir, report_path=report_path,
         wall_seconds=time.perf_counter() - t0,
         rows_by_slice=rows_by_slice,
+        batch_stats=batch_stats,
     )

@@ -137,7 +137,7 @@ def _scan_all_shards(out_dir: Path, header: dict):
 
 def _shard_node_main(
     spec_src, trace_path, shard_dir, only, resume,
-    result_cache, workers, compile_cache,
+    result_cache, workers, compile_cache, scenario_batch=None,
 ):
     """One shard process: price exactly ``only`` into this shard's
     journal.  Module-level so every multiprocessing start method can
@@ -155,6 +155,7 @@ def _shard_node_main(
             validate=False,
             compile_cache=compile_cache,
             only=only,
+            scenario_batch=scenario_batch,
         )
     except Exception as e:  # noqa: BLE001 - process boundary
         print(
@@ -177,6 +178,7 @@ def run_sharded_campaign(
     progress=None,
     validate: bool = True,
     on_spawn=None,
+    scenario_batch: bool | str | None = None,
 ) -> CampaignResult:
     """Execute one campaign sharded across ``nodes`` local node
     processes; returns a :class:`CampaignResult` whose report document
@@ -277,6 +279,7 @@ def run_sharded_campaign(
                     resume or wave > 0
                     or (shard_dir / "journal.jsonl").exists(),
                     result_cache, workers, compile_cache,
+                    scenario_batch,
                 ),
                 name=f"tpusim-campaign-shard-{node}",
             )

@@ -18,6 +18,17 @@ other item").  This package splits that walk into two phases:
   channels, ICI rendezvous, HBM contention, control flow) steps through
   the same scalar logic as the reference walk.
 
+* **batch** (:mod:`tpusim.fastpath.batch`) — the scenario axis: S
+  degradation states of one module price as ONE lane-axis pass — the
+  per-state scale transforms broadcast onto the shared columns as an
+  ``(S, ops)`` matrix, runs collapse through row-wise serial scans
+  (NumPy, the fused ``op_price_scan_batch`` C kernel, or the optional
+  ``jax.jit``/``vmap`` backend in :mod:`tpusim.fastpath.jax_backend`),
+  and collective/contended steps stay per-lane scalar.
+  ``warm_states`` feeds campaign/fleet: batch-priced lanes land in the
+  result cache under the exact per-state keys, so the unchanged driver
+  walk consumes pure hits and report bytes cannot move.
+
 * **store** (:mod:`tpusim.fastpath.store`) — the durable tier: compiled
   columns + step programs serialized into the shared disk store beside
   the PR 4 result records (``.cmod`` beside ``.json``), mmap-loaded by
@@ -35,6 +46,13 @@ the serial walk) under obs instrumentation, timeline recording, and
 op-granularity checkpoint/resume — see ``resolve_backend``.
 """
 
+from tpusim.fastpath.batch import (
+    BATCH_BACKENDS,
+    BatchStats,
+    price_module_batch,
+    resolve_batch_backend,
+    warm_states,
+)
 from tpusim.fastpath.compile import CompiledComputation, CompiledModule, compile_module
 from tpusim.fastpath.price import (
     BACKENDS,
@@ -42,8 +60,9 @@ from tpusim.fastpath.price import (
     numpy_available,
     price_module,
     resolve_backend,
+    resolve_engine_scales,
 )
-from tpusim.fastpath.native import native_price_available
+from tpusim.fastpath.native import native_batch_available, native_price_available
 from tpusim.fastpath.store import (
     CompileStore,
     as_compile_store,
@@ -54,6 +73,8 @@ from tpusim.fastpath.store import (
 
 __all__ = [
     "BACKENDS",
+    "BATCH_BACKENDS",
+    "BatchStats",
     "CompileStore",
     "CompiledComputation",
     "CompiledModule",
@@ -62,9 +83,14 @@ __all__ = [
     "compile_store_active",
     "fastpath_eligible",
     "get_compile_store",
+    "native_batch_available",
     "native_price_available",
     "numpy_available",
     "price_module",
+    "price_module_batch",
     "resolve_backend",
+    "resolve_batch_backend",
+    "resolve_engine_scales",
     "set_compile_store",
+    "warm_states",
 ]

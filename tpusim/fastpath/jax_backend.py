@@ -1,0 +1,93 @@
+"""Optional ``jax.jit`` scan backend for scenario-batched pricing.
+
+The compiled columns are already flat float64 arrays, so the lane-axis
+row scans of :mod:`tpusim.fastpath.batch` map directly onto XLA: one
+1-D serial scan (``jax.lax.scan`` — a strict left-to-right carry, the
+same float sequence as NumPy's ``cumsum``) ``vmap``-ed over the
+scenario axis and ``jit``-compiled once per column shape.  Byte
+identity holds because the scan never reassociates: lane ``s`` performs
+the per-state walk's exact ``+=`` chain in IEEE-754 binary64 (jax x64
+mode), which is also why ``jnp.cumsum`` is deliberately NOT used — XLA
+may lower it as a parallel prefix sum whose association order differs.
+
+Import-guarded: machines without jax lose nothing — the backend refuses
+to resolve (``jax_price_available`` is False) and the NumPy/native
+paths carry on.  x64 mode is enabled lazily on FIRST availability
+probe, i.e. only once a caller explicitly requests the jax backend;
+importing this module (or tpusim generally) never flips global jax
+config under an embedding process.
+"""
+
+from __future__ import annotations
+
+__all__ = ["jax_price_available", "jax_scan_rows"]
+
+_STATE = {"tried": False, "fn": None}
+
+
+def _load():
+    if _STATE["tried"]:
+        return _STATE["fn"]
+    _STATE["tried"] = True
+    try:
+        import jax
+    except Exception:
+        return None
+    try:
+        jax.config.update("jax_enable_x64", True)
+        import jax.numpy as jnp
+
+        if jnp.zeros(1).dtype != jnp.float64:
+            return None  # x64 could not be enabled: parity impossible
+
+        def _scan_lane(seed, row):
+            def step(carry, x):
+                nxt = carry + x
+                return nxt, nxt
+
+            _, outs = jax.lax.scan(step, seed, row)
+            return outs
+
+        fn = jax.jit(jax.vmap(_scan_lane))
+        # smoke-execute once so a broken backend fails the probe, not
+        # the first pricing call
+        import numpy
+
+        probe = fn(
+            jnp.asarray([0.5]), jnp.asarray([[1.0, 2.0, 3.0]])
+        )
+        expect = numpy.cumsum([0.5, 1.0, 2.0, 3.0])[1:]
+        if numpy.asarray(probe).tobytes() != expect.tobytes():
+            return None
+        _STATE["fn"] = fn
+    except Exception:
+        return None
+    return _STATE["fn"]
+
+
+def jax_price_available() -> bool:
+    """True when jax imports, x64 enables, and the vmapped serial scan
+    reproduces NumPy's cumsum bytes on a probe input."""
+    return _load() is not None
+
+
+def jax_scan_rows(seeds, mat):
+    """Row-seeded serial scans on XLA: returns the ``(S, k+1)`` NumPy
+    array ``_BatchCtx._scan_rows_np`` would produce, byte for byte
+    (row ``s`` is ``cumsum([seeds[s], *mat[s]])``)."""
+    import numpy
+
+    fn = _load()
+    assert fn is not None
+    import jax.numpy as jnp
+
+    S, k = mat.shape
+    out = numpy.empty((S, k + 1))
+    out[:, 0] = seeds
+    if k:
+        scans = fn(
+            jnp.asarray(out[:, 0]),
+            jnp.asarray(numpy.ascontiguousarray(mat)),
+        )
+        out[:, 1:] = numpy.asarray(scans)
+    return out

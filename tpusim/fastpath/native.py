@@ -19,10 +19,17 @@ from __future__ import annotations
 
 import ctypes
 
-__all__ = ["native_price_available", "price_scan"]
+__all__ = [
+    "native_batch_available",
+    "native_price_available",
+    "price_scan",
+    "price_scan_batch",
+]
 
 _LIB: ctypes.CDLL | None = None
 _LIB_TRIED = False
+_BATCH: ctypes.CDLL | None = None
+_BATCH_TRIED = False
 
 _ACC_SLOTS = 7  # [t, flops, mxu, trans, hbm, vmem, spill]
 
@@ -66,6 +73,47 @@ def native_price_available() -> bool:
     return _load() is not None
 
 
+def _load_batch() -> ctypes.CDLL | None:
+    """The scenario-batched scan, probed separately: a prebuilt library
+    from before the batch kernel existed still serves the scalar scan
+    while the batch path falls back to NumPy (byte-identical either
+    way)."""
+    global _BATCH, _BATCH_TRIED
+    if _BATCH_TRIED:
+        return _BATCH
+    _BATCH_TRIED = True
+    lib = _load()
+    if lib is None:
+        return None
+    try:
+        lib.op_price_batch_abi_version.restype = ctypes.c_int
+        if lib.op_price_batch_abi_version() != 1:
+            return None
+        lib.op_price_scan_batch.restype = None
+        lib.op_price_scan_batch.argtypes = [
+            ctypes.c_int64,                   # lanes
+            ctypes.c_int64,                   # n
+            ctypes.POINTER(ctypes.c_double),  # dur (lanes*n, lane-major)
+            ctypes.POINTER(ctypes.c_double),  # flops (shared, n)
+            ctypes.POINTER(ctypes.c_double),  # mxu
+            ctypes.POINTER(ctypes.c_double),  # trans
+            ctypes.POINTER(ctypes.c_double),  # hbm
+            ctypes.POINTER(ctypes.c_double),  # vmem
+            ctypes.POINTER(ctypes.c_double),  # spilled (may be NULL)
+            ctypes.POINTER(ctypes.c_double),  # acc (lanes*7, in/out)
+            ctypes.POINTER(ctypes.c_double),  # t_before (may be NULL)
+        ]
+        _BATCH = lib
+    except (OSError, AttributeError):
+        return None
+    return _BATCH
+
+
+def native_batch_available() -> bool:
+    """True when the scenario-batched scan is loadable."""
+    return _load_batch() is not None
+
+
 _DP = ctypes.POINTER(ctypes.c_double)
 
 
@@ -90,4 +138,26 @@ def price_scan(dur, flops, mxu, trans, hbm, vmem, spilled, acc,
         _ptr(spilled) if spilled is not None else None,
         _ptr(acc),
         _ptr(t_before) if t_before is not None else None,
+    )
+
+
+def price_scan_batch(dur2, flops, mxu, trans, hbm, vmem, spilled, acc2,
+                     t_before2=None) -> None:
+    """Run the fused lane-major batch scan.  ``dur2`` is (lanes, n)
+    C-contiguous float64 (per-lane transformed durations); the counter
+    columns are the SHARED 1-D arrays (lane-invariant by the degrade /
+    spill transform structure); ``acc2`` is (lanes, 7), updated in
+    place; ``t_before2`` (lanes, n) receives each lane's pre-op clock
+    when per-op aggregates are being collected."""
+    lib = _load_batch()
+    assert lib is not None
+    lanes, n = dur2.shape
+    assert acc2.shape == (lanes, _ACC_SLOTS)
+    lib.op_price_scan_batch(
+        lanes, n,
+        _ptr(dur2), _ptr(flops), _ptr(mxu), _ptr(trans),
+        _ptr(hbm), _ptr(vmem),
+        _ptr(spilled) if spilled is not None else None,
+        _ptr(acc2),
+        _ptr(t_before2) if t_before2 is not None else None,
     )

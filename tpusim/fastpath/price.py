@@ -39,6 +39,7 @@ __all__ = [
     "numpy_available",
     "price_module",
     "resolve_backend",
+    "resolve_engine_scales",
 ]
 
 BACKENDS = ("auto", "serial", "vectorized", "native")
@@ -106,6 +107,17 @@ def resolve_backend(requested: str | None = None) -> str:
     if native_price_available():
         return "native"
     return "vectorized"
+
+
+def resolve_engine_scales(engine) -> tuple[float, float]:
+    """The launch-class scale pair one pricing call runs under.
+
+    Single source of truth shared by the per-state walk (``_Ctx``) and
+    the scenario-batched walk (:mod:`tpusim.fastpath.batch`): if the
+    scales ever come from somewhere richer than the engine's
+    ``clock_scale``/``hbm_scale`` attributes, both paths move together
+    instead of diverging silently."""
+    return engine.clock_scale, engine.hbm_scale
 
 
 def fastpath_eligible(engine) -> bool:
@@ -198,8 +210,7 @@ class _Ctx:
         self.arch = a
         self.config = engine.config
         self.degraded = engine._degraded
-        self.cs = engine.clock_scale
-        self.hs = engine.hbm_scale
+        self.cs, self.hs = resolve_engine_scales(engine)
         self.spill_frac = spill_frac
         self.hbm_bpc = a.hbm_bytes_per_cycle
         self.vmem_bpc = a.vmem_bytes_per_cycle

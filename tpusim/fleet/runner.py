@@ -113,6 +113,12 @@ class FleetResult:
     out_dir: Path | None = None
     report_path: Path | None = None
     wall_seconds: float = 0.0
+    #: scenario-batched pricing accounting
+    #: (:class:`tpusim.fastpath.batch.BatchStats`) when the warm phase
+    #: ran; None when batching was disabled.  Report/journal bytes are
+    #: the per-state walk's either way — the batch only publishes
+    #: cache entries the state replays then hit.
+    batch_stats: object | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -531,6 +537,7 @@ def run_fleet(
     progress=None,
     cancel=None,
     compile_cache=None,
+    scenario_batch: bool | str | None = None,
 ) -> FleetResult:
     """Execute one fleet twin end to end.
 
@@ -545,7 +552,15 @@ def run_fleet(
     errors.  ``cancel`` (a :class:`tpusim.guard.CancelToken`) cancels
     cooperatively at state/recovery/cell grain with everything priced
     so far journaled — the serve tier's ``DELETE /v1/jobs/<id>`` and
-    the CLI's ``--max-wall-s`` both arrive here."""
+    the CLI's ``--max-wall-s`` both arrive here.
+
+    ``scenario_batch`` controls the scenario-batched pricing fastpath
+    (:mod:`tpusim.fastpath.batch`): ``None``/``True`` (the default)
+    batch-warms the pending degradation states of each timeline group
+    into the shared result cache before the state loop prices them,
+    ``False`` disables it (the ``--no-scenario-batch`` flag), and a
+    backend name from ``BATCH_BACKENDS`` pins the batch backend.
+    Batching never changes journal or report bytes."""
     from tpusim.ici.topology import torus_for
     from tpusim.perf.cache import ResultCache, as_result_cache
     from tpusim.timing.config import load_config
@@ -596,6 +611,11 @@ def run_fleet(
 
     stats = FleetStats()
     stats.pods = spec.pods
+    batch_stats = None
+    if scenario_batch is not False:
+        from tpusim.fastpath.batch import BatchStats
+
+        batch_stats = BatchStats()
     cache = as_result_cache(result_cache) or ResultCache()
     chips = spec.chips or default_chips
     cfg = load_config(
@@ -670,6 +690,53 @@ def run_fleet(
 
         rows_by_sig: dict[str, dict] = {}
         healthy_sig = state_signature([])
+
+        def warm_timelines(tls) -> None:
+            """Scenario-batched cache warm: bind every pending distinct
+            degradation state across ``tls`` and batch-price its launch
+            classes into the shared result cache, so the ``priced``
+            calls that follow consume pure hits.  Strictly an
+            optimization (cancellation excepted) — a failure leaves the
+            state loop to price per-state with identical journal/report
+            bytes, pinned by the ``--fastpath-parity`` BATCHED leg."""
+            if batch_stats is None:
+                return
+            from tpusim.guard import OperationCancelled
+
+            try:
+                from tpusim.faults import load_fault_schedule
+                from tpusim.fastpath.batch import warm_states
+
+                states, seen = [], set()
+                for tl in tls:
+                    for _lo, _hi, sig, docs in tl:
+                        if (
+                            not docs or sig in seen
+                            or sig in rows_by_sig or sig in state_done
+                        ):
+                            continue
+                        seen.add(sig)
+                        st = load_fault_schedule(
+                            {"faults": docs}
+                        ).bind(topo)
+                        if check_partition and _disconnected(
+                            topo, st.view_at(0.0), replay_chips,
+                        ):
+                            continue  # becomes a partitioned row
+                        states.append(st)
+                if states:
+                    batch_stats.merge(warm_states(
+                        pod, cfg, topo, states, cache,
+                        backend=(scenario_batch
+                                 if isinstance(scenario_batch, str)
+                                 else None),
+                        cancel=cancel,
+                    ))
+            except OperationCancelled:
+                raise
+            except Exception:  # noqa: BLE001 — warming is best-effort
+                pass
+
         healthy = priced(healthy_sig, [], None)
         if healthy["partitioned"] or not healthy["step_s"]:
             raise ValueError(
@@ -681,6 +748,7 @@ def run_fleet(
         # ladder and price LAZILY when a rung first stands them up —
         # a ladder meeting its SLO at 3 pods never replays pod 40's
         # fault states (resume stays sig-keyed, order-free)
+        warm_timelines(timelines[: spec.pods])
         for tl in timelines[: spec.pods]:
             for _lo, _hi, sig, docs in tl:
                 if sig not in rows_by_sig:
@@ -692,6 +760,7 @@ def run_fleet(
             ps = pod_state_cache.get(p)
             if ps is None:
                 tl = timelines[p]
+                warm_timelines([tl])
                 for _lo, _hi, sig, docs in tl:
                     if sig not in rows_by_sig:
                         priced(sig, docs, healthy)
@@ -784,6 +853,7 @@ def run_fleet(
     return FleetResult(
         doc=doc, stats=stats, out_dir=out_dir, report_path=report_path,
         wall_seconds=time.perf_counter() - t0,
+        batch_stats=batch_stats,
     )
 
 

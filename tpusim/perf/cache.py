@@ -102,6 +102,8 @@ _PARSER_FILES: tuple[str, ...] = (
     "tpusim/fastpath/compile.py",
     "tpusim/fastpath/price.py",
     "tpusim/fastpath/native.py",
+    "tpusim/fastpath/batch.py",
+    "tpusim/fastpath/jax_backend.py",
     "native/op_price.cpp",
 )
 
