@@ -742,6 +742,45 @@ def _scenario_batch_sweep(lanes: int = 48) -> dict | None:
     }
 
 
+def _multislice_ar_leg(arch: str = "v5p") -> dict | None:
+    """Multi-slice fabric micro-headline (PR 20): the modeled time of a
+    64 MiB all-reduce spanning a 2-slice, 8-chip system over the
+    tpusim.dcn fabric — hierarchical in-slice reduce-scatter, cross-slice
+    all-reduce on the NIC-derived bandwidth, in-slice all-gather — with
+    the flat scalar-DCN model it must beat riding as detail.  Pure model
+    evaluation: deterministic, no silicon, byte-pinned by the CI dcn
+    smoke (ci/check_golden --dcn-smoke) and tests/test_dcn.py."""
+    from tpusim.ici.collectives import CollectiveModel
+    from tpusim.ici.topology import torus_for
+    from tpusim.timing.config import load_config
+
+    def _ici(overlay):
+        return load_config(
+            arch=arch, overlays=[{"arch": {"ici": overlay}}],
+        ).arch.ici
+
+    payload = float(64 << 20)
+    n = 8
+    topo = torus_for(n, arch)
+    flat = CollectiveModel(topo, _ici({"chips_per_slice": 4}))
+    fab = CollectiveModel(topo, _ici({
+        "chips_per_slice": 4, "dcn_nics_per_slice": 4,
+        "dcn_hop_bandwidth": 25e9, "dcn_hop_latency": 1e-5,
+    }))
+    hier_s = fab.allreduce_seconds(payload, n)
+    flat_s = flat.allreduce_seconds(payload, n)
+    if not (hier_s > 0 and math.isfinite(hier_s)):
+        return None
+    return {
+        "multislice_ar_us": round(hier_s * 1e6, 1),
+        "payload_mib": 64,
+        "slices": 2,
+        "chips": n,
+        "flat_dcn_us": round(flat_s * 1e6, 1),
+        "hier_speedup": round(flat_s / hier_s, 2),
+    }
+
+
 def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     """Replay committed TPU traces against their committed measured times.
 
@@ -830,6 +869,19 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
     except Exception as e:
         log(f"bench(fixture): scenario-batch leg FAILED: "
             f"{type(e).__name__}: {e}")
+    # multi-slice fabric leg (PR 20): the modeled hierarchical AR over
+    # the DCN fabric vs the flat scalar model it degenerates to
+    multislice = None
+    try:
+        multislice = _multislice_ar_leg(arch)
+        if multislice is not None:
+            log(f"bench(fixture): multislice-ar 64MiB x{multislice['slices']} "
+                f"slices hier={multislice['multislice_ar_us']:.1f}us "
+                f"flat={multislice['flat_dcn_us']:.1f}us "
+                f"speedup={multislice['hier_speedup']:.2f}x")
+    except Exception as e:
+        log(f"bench(fixture): multislice-ar leg FAILED: "
+            f"{type(e).__name__}: {e}")
     for name, sim_s, real_s, err, src, _fl, _hb, _ops in rows:
         # ground-truth provenance: entries captured before the
         # device-timeline change (or where the profiler failed) hold
@@ -893,6 +945,12 @@ def fixture_main(fixture_dir: Path = FIXTURE_DIR) -> int | None:
             scenario_batch["scenario_batch_kops_s"]
             if scenario_batch else None),
         "scenario_batch": scenario_batch,
+        # multi-slice fabric micro-headline (PR 20): modeled 64 MiB
+        # hierarchical all-reduce over a 2-slice tpusim.dcn fabric,
+        # with the flat scalar model and speedup riding as detail
+        "multislice_ar_us": (
+            multislice["multislice_ar_us"] if multislice else None),
+        "multislice_ar": multislice,
         # which tpusim.fastpath backend priced (serial/vectorized/native)
         "pricing_backend": pricing_backend,
         # simulator throughput + cache effectiveness ride the artifact

@@ -1100,6 +1100,128 @@ def campaign_smoke(update: bool = False) -> dict:
     }
 
 
+#: the dcn smoke: a fixed-seed campaign over a 2-slice system with a
+#: modeled DCN fabric and slice-targeted fault kinds.  Seed 7 on a
+#: 4-chip / 2-slice spec was picked so the sampler lands both
+#: slice-loss scenarios (the "how many slices survive" answer) and
+#: surviving-fabric scenarios in 8 draws.  tuned=False like every
+#: golden: the report must not shift when a live run refreshes the fit.
+DCN_SMOKE_FIXTURE = "llama_tiny_tp2dp2"
+DCN_SMOKE_GOLDEN = GOLDEN_DIR / "dcn_smoke.json"
+DCN_SMOKE_SPEC = {
+    "name": "ci-dcn-smoke",
+    "seed": 7,
+    "scenarios": 8,
+    "arch": "v5p",
+    "chips": 4,
+    "tuned": False,
+    "dcn": {
+        "num_slices": 2,
+        "nics_per_slice": 2,
+        "nic_bandwidth": 25e9,
+        "hop_latency": 1e-5,
+    },
+    "faults": {
+        "count": {"dist": "uniform", "min": 1, "max": 2},
+        "kinds": {"slice_down": 2.0, "dcn_link_down": 1.0,
+                  "link_degraded": 0.5},
+        "scale": {"min": 0.4, "max": 0.9},
+    },
+}
+
+
+def dcn_smoke(update: bool = False) -> dict:
+    """Multi-slice fabric contract (tpusim.dcn):
+
+    1. the fixed-seed DCN campaign's report must be byte-identical to
+       the committed golden (regen with ``--dcn-smoke --update``);
+    2. the report must answer slice survival: a ``dcn`` section with at
+       least one slice-loss scenario, a survival histogram covering
+       every scenario, and slice-loss rows attributed as partitions;
+    3. the hierarchical decomposition must actually engage — a
+       slice-spanning all-reduce over the fabric prices strictly
+       cheaper than the flat scalar model at a bandwidth-bound payload;
+    4. an UNCONFIGURED fabric must price byte-identically to the flat
+       model (the back-compat degeneration contract).
+    Raises on violation."""
+    from tpusim.campaign import run_campaign
+    from tpusim.ici.collectives import CollectiveModel
+    from tpusim.ici.topology import torus_for
+    from tpusim.timing.config import load_config
+
+    res = run_campaign(
+        DCN_SMOKE_SPEC, trace_path=FIXTURES / DCN_SMOKE_FIXTURE,
+    )
+    got = json.dumps(res.doc, indent=1, sort_keys=True) + "\n"
+    if update:
+        GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+        DCN_SMOKE_GOLDEN.write_text(got)
+    if not DCN_SMOKE_GOLDEN.exists():
+        raise ValueError(
+            f"no dcn golden {DCN_SMOKE_GOLDEN} (run --dcn-smoke --update)"
+        )
+    if got != DCN_SMOKE_GOLDEN.read_text():
+        raise ValueError(
+            "dcn smoke: fixed-seed report diverged from the committed "
+            "golden (byte comparison failed) — a fabric-model or "
+            "campaign-report change must regen with --dcn-smoke --update"
+        )
+
+    sl = res.doc["slices"][0]
+    dcn = sl.get("dcn")
+    if not dcn or dcn["slice_loss_scenarios"] < 1:
+        raise ValueError(
+            "dcn smoke: no slice-loss scenario landed (the seed was "
+            "chosen to produce them)"
+        )
+    if sum(dcn["slices_ok_hist"].values()) != sl["scenarios"]:
+        raise ValueError(
+            "dcn smoke: survival histogram does not cover every scenario"
+        )
+    for row in res.doc["rows"]:
+        if row["dcn"]["slices_lost"] > 0 and \
+                row.get("status") != "partitioned":
+            raise ValueError(
+                f"dcn smoke: slice-loss row {row['index']} not "
+                f"attributed as a partition"
+            )
+
+    def _ici(overlay):
+        return load_config(
+            arch="v5p", overlays=[{"arch": {"ici": overlay}}],
+            tuned=False,
+        ).arch.ici
+
+    topo = torus_for(8, "v5p")
+    payload = float(64 << 20)
+    flat = CollectiveModel(topo, _ici({"chips_per_slice": 4}))
+    fab = CollectiveModel(topo, _ici({
+        "chips_per_slice": 4, "dcn_nics_per_slice": 4,
+        "dcn_hop_bandwidth": 25e9, "dcn_hop_latency": 1e-5,
+    }))
+    flat_s = flat.allreduce_seconds(payload, 8)
+    hier_s = fab.allreduce_seconds(payload, 8)
+    if not hier_s < flat_s:
+        raise ValueError(
+            f"dcn smoke: hierarchical all-reduce did not beat the flat "
+            f"model ({hier_s} vs {flat_s})"
+        )
+    unconfigured = CollectiveModel(topo, _ici({
+        "chips_per_slice": 4, "dcn_hop_bandwidth": 25e9,
+    }))
+    if unconfigured.allreduce_seconds(payload, 8) != flat_s:
+        raise ValueError(
+            "dcn smoke: NIC-less config did not degenerate "
+            "byte-identically to the flat scalar model"
+        )
+    return {
+        "scenarios": sl["scenarios"],
+        "slice_losses": dcn["slice_loss_scenarios"],
+        "min_slices_ok": dcn["min_slices_ok"],
+        "hier_speedup": flat_s / hier_s,
+    }
+
+
 #: the fleet smoke: a fixed-seed fleet digital-twin run on the
 #: llama_tiny fixture whose report must be BYTE-identical to the
 #: committed golden.  Seed 3 + pod_loss prob 0.9 was picked to exercise
@@ -2801,6 +2923,15 @@ def main(argv: list[str] | None = None) -> int:
                          "committed golden (partition rate, inflation "
                          "percentiles, capacity table included) and "
                          "the healthy golden matrix must be untouched")
+    ap.add_argument("--dcn-smoke", action="store_true",
+                    help="run the fixed-seed DCN campaign on a 2-slice "
+                         "4-chip system: the report must be "
+                         "byte-identical to the committed golden, "
+                         "answer slice survival (loss scenarios + "
+                         "histogram, partition attribution), the "
+                         "hierarchical all-reduce must beat the flat "
+                         "scalar model, and an unconfigured fabric "
+                         "must degenerate byte-identically")
     ap.add_argument("--fleet-smoke", action="store_true",
                     help="run the fixed-seed fleet digital twin on the "
                          "llama_tiny fixture: the report must be "
@@ -2959,6 +3090,20 @@ def main(argv: list[str] | None = None) -> int:
               f"{summary['gc_deleted']} record(s), store never over "
               f"quota; deadline 504 via in-process cancel with zero "
               f"restarts across {summary['serve_workers']} workers)")
+        return 0
+
+    if args.dcn_smoke:
+        try:
+            summary = dcn_smoke(update=args.update)
+        except (ValueError, OSError, KeyError) as e:
+            print(f"ci/check_golden --dcn-smoke: FAILED: {e}")
+            return 1
+        print(f"ci/check_golden --dcn-smoke: OK "
+              f"({summary['scenarios']:.0f} scenarios byte-identical, "
+              f"{summary['slice_losses']:.0f} slice-loss outcomes, "
+              f"min {summary['min_slices_ok']:.0f} slice(s) survive, "
+              f"hierarchical all-reduce "
+              f"{summary['hier_speedup']:.2f}x over flat)")
         return 0
 
     if args.campaign_smoke:

@@ -81,21 +81,31 @@
 #                byte-for-byte (inflation percentiles, partition rate,
 #                SLO capacity table), with the healthy golden matrix
 #                untouched
-#  15. advise  — sharding-advisor determinism: a fixed-spec strategy
+#  15. dcn     — multi-slice fabric contract (tpusim.dcn): a fixed-seed
+#                campaign over a 2-slice system with DCN fault kinds
+#                (slice_down / dcn_link_down / link_degraded) must
+#                reproduce the committed report byte-for-byte with the
+#                slice-survival answer intact (loss scenarios, survival
+#                histogram, partition attribution), the hierarchical
+#                all-reduce decomposition must beat the flat scalar
+#                model at a bandwidth-bound payload, and an
+#                unconfigured fabric must degenerate byte-identically
+#                to the flat model
+#  16. advise  — sharding-advisor determinism: a fixed-spec strategy
 #                sweep on the llama_tiny fixture must reproduce the
 #                committed ranked report byte-for-byte (step-time/
 #                ICI-bytes/HBM/watts columns, dp=4 x tp=2 synthesizing
 #                the 14-collective MULTICHIP_r05 step), with a warm
 #                pass running zero engine walks and the healthy golden
 #                matrix untouched
-#  16. guard   — resource-governance contract (tpusim.guard): the
+#  17. guard   — resource-governance contract (tpusim.guard): the
 #                golden matrix under a small --cache-quota stays
 #                byte-identical while the cache dir never exceeds the
 #                quota (LRU GC provably engaged), and a served request
 #                past its deadline 504s through cooperative in-process
 #                cancellation with the worker still alive (zero
 #                restarts/kills, warm caches serving the next request)
-#  17. fleet   — fleet digital-twin determinism (tpusim.fleet): a
+#  18. fleet   — fleet digital-twin determinism (tpusim.fleet): a
 #                fixed-seed traffic-driven fleet simulation on the
 #                llama_tiny fixture must reproduce the committed
 #                report byte-for-byte (goodput/p99 curve, per-policy
@@ -103,7 +113,7 @@
 #                loss with its elastic-recovery row, a non-null
 #                capacity-frontier answer), with the healthy golden
 #                matrix untouched
-#  18. dataflow — tpusim.analysis v2 contract: committed fixtures +
+#  19. dataflow — tpusim.analysis v2 contract: committed fixtures +
 #                golden-matrix traces lint clean of TL4xx/TL41x
 #                errors, the liveness pass agrees byte-for-byte with
 #                the engine's residency walk across the fixture +
@@ -111,7 +121,7 @@
 #                mismatched-collective trace is statically refused,
 #                and the TL35x determinism/durability self-audit over
 #                tpusim/'s own sources is green
-#  19. cluster — multi-node cluster contract (serve --join +
+#  20. cluster — multi-node cluster contract (serve --join +
 #                campaign --nodes): the golden matrix byte-identical
 #                served single-node vs through both nodes of a 2-node
 #                localhost fleet (hot/compiled tiers engaged,
@@ -122,7 +132,7 @@
 #                and coordinator killed then --resume'd — merging
 #                byte-identical to the uninterrupted single-node
 #                report with zero re-priced scenarios
-#  20. perflint — tpusim.analysis v3 perf-lint contract (TL5xx):
+#  21. perflint — tpusim.analysis v3 perf-lint contract (TL5xx):
 #                healthy fixtures emit a TL500 critical-path summary
 #                and zero TL5xx errors across the arch matrix, the
 #                critical-path <= engine-cycles <= serial-op-sum
@@ -133,15 +143,15 @@
 #                a strict-lint serve daemon admits TL5xx-only
 #                verdicts (advisory, never refusing), and the
 #                self-audit (now incl. TL353 fork-safety) is green
-#  21. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
+#  22. slow    — full pytest incl. subprocess CPU-mesh SPMD tests
 #                (opt-in: CI_SLOW=1)
 #
-# Usage:  bash ci/run_ci.sh            # tiers 1-20
+# Usage:  bash ci/run_ci.sh            # tiers 1-21
 #         CI_SLOW=1 bash ci/run_ci.sh  # all tiers
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== [1/21] build native from source (+ native parity suite) ==="
+echo "=== [1/22] build native from source (+ native parity suite) ==="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
   make -C native clean all
   python -m pytest tests/test_native.py tests/test_fastpath.py -q -m "not slow"
@@ -155,7 +165,7 @@ else
   echo "**********************************************************************"
 fi
 
-echo "=== [2/21] repo static analysis (ruff / stdlib fallback) ==="
+echo "=== [2/22] repo static analysis (ruff / stdlib fallback) ==="
 lint_rc=0
 python ci/lint_repo.py --json > /tmp/tpusim_lint_repo.json || lint_rc=$?
 python - <<'PYEOF'
@@ -167,65 +177,68 @@ for f in doc["findings"]:
 PYEOF
 [[ "$lint_rc" == "0" ]] || exit "$lint_rc"
 
-echo "=== [3/21] unit tests (fast tier) ==="
+echo "=== [3/22] unit tests (fast tier) ==="
 python -m pytest tests/ -q -m "not slow"
 
-echo "=== [4/21] golden-stat regression sims ==="
+echo "=== [4/22] golden-stat regression sims ==="
 python ci/check_golden.py
 
-echo "=== [5/21] obs export smoke (schema-checked) ==="
+echo "=== [5/22] obs export smoke (schema-checked) ==="
 python ci/check_golden.py --obs-smoke
 
-echo "=== [6/21] faults smoke (degraded-pod contract) ==="
+echo "=== [6/22] faults smoke (degraded-pod contract) ==="
 python ci/check_golden.py --faults-smoke
 
-echo "=== [7/21] trace/config/schedule lint smoke ==="
+echo "=== [7/22] trace/config/schedule lint smoke ==="
 python ci/check_golden.py --lint-smoke
 
-echo "=== [8/21] perf smoke (parallel+cached determinism) ==="
+echo "=== [8/22] perf smoke (parallel+cached determinism) ==="
 python ci/check_golden.py --perf-smoke
 
-echo "=== [9/21] fastpath parity (pricing-backend + durable-tier + scenario-batch byte-identity) ==="
+echo "=== [9/22] fastpath parity (pricing-backend + durable-tier + scenario-batch byte-identity) ==="
 python ci/check_golden.py --fastpath-parity
 
-echo "=== [10/21] serve smoke (HTTP daemon determinism, 1..N workers) ==="
+echo "=== [10/22] serve smoke (HTTP daemon determinism, 1..N workers) ==="
 python ci/check_golden.py --serve-smoke
 
-echo "=== [11/21] serve chaos smoke (worker SIGKILL survivability) ==="
+echo "=== [11/22] serve chaos smoke (worker SIGKILL survivability) ==="
 python ci/check_golden.py --serve-chaos-smoke
 
-echo "=== [12/21] front smoke (serve v3 multi-acceptor contract) ==="
+echo "=== [12/22] front smoke (serve v3 multi-acceptor contract) ==="
 python ci/check_golden.py --front-smoke
 
-echo "=== [13/21] reqtrace smoke (request-tracing + latency-histogram contract) ==="
+echo "=== [13/22] reqtrace smoke (request-tracing + latency-histogram contract) ==="
 python ci/check_golden.py --reqtrace-smoke
 
-echo "=== [14/21] campaign smoke (Monte-Carlo determinism) ==="
+echo "=== [14/22] campaign smoke (Monte-Carlo determinism) ==="
 python ci/check_golden.py --campaign-smoke
 
-echo "=== [15/21] advise smoke (sharding-advisor determinism) ==="
+echo "=== [15/22] dcn smoke (multi-slice fabric contract) ==="
+python ci/check_golden.py --dcn-smoke
+
+echo "=== [16/22] advise smoke (sharding-advisor determinism) ==="
 python ci/check_golden.py --advise-smoke
 
-echo "=== [16/21] guard smoke (quota/GC + cooperative-cancel contract) ==="
+echo "=== [17/22] guard smoke (quota/GC + cooperative-cancel contract) ==="
 python ci/check_golden.py --guard-smoke
 
-echo "=== [17/21] fleet smoke (digital-twin determinism) ==="
+echo "=== [18/22] fleet smoke (digital-twin determinism) ==="
 python ci/check_golden.py --fleet-smoke
 
-echo "=== [18/21] dataflow smoke (liveness/deadlock/self-audit contract) ==="
+echo "=== [19/22] dataflow smoke (liveness/deadlock/self-audit contract) ==="
 python ci/check_golden.py --dataflow-smoke
 
-echo "=== [19/21] cluster smoke (multi-node membership + distributed campaign chaos) ==="
+echo "=== [20/22] cluster smoke (multi-node membership + distributed campaign chaos) ==="
 python ci/check_golden.py --cluster-smoke
 
-echo "=== [20/21] perf-lint smoke (critical-path/TL5xx contract) ==="
+echo "=== [21/22] perf-lint smoke (critical-path/TL5xx contract) ==="
 python ci/check_golden.py --perf-lint-smoke
 
 if [[ "${CI_SLOW:-0}" == "1" ]]; then
-  echo "=== [21/21] slow tier (SPMD subprocess meshes) ==="
+  echo "=== [22/22] slow tier (SPMD subprocess meshes) ==="
   python -m pytest tests/ -q -m slow
 else
-  echo "=== [21/21] slow tier skipped (set CI_SLOW=1) ==="
+  echo "=== [22/22] slow tier skipped (set CI_SLOW=1) ==="
 fi
 
 echo "CI: all tiers green"

@@ -35,19 +35,21 @@ def test_golden_catches_model_change(matrix_results):
 
 def test_golden_files_are_committed():
     # one stats golden per matrix row, plus the campaign-smoke,
-    # advise-smoke, and fleet-smoke reports (different document
-    # shapes, pinned by their own --*-smoke modes)
+    # advise-smoke, fleet-smoke, and dcn-smoke reports (different
+    # document shapes, pinned by their own --*-smoke modes)
     goldens = list((REPO / "ci" / "golden").glob("*.json"))
     matrix = [
         g for g in goldens
         if g not in (check_golden.CAMPAIGN_SMOKE_GOLDEN,
                      check_golden.ADVISE_SMOKE_GOLDEN,
-                     check_golden.FLEET_SMOKE_GOLDEN)
+                     check_golden.FLEET_SMOKE_GOLDEN,
+                     check_golden.DCN_SMOKE_GOLDEN)
     ]
     assert len(matrix) == len(check_golden.MATRIX)
     assert check_golden.CAMPAIGN_SMOKE_GOLDEN in goldens
     assert check_golden.ADVISE_SMOKE_GOLDEN in goldens
     assert check_golden.FLEET_SMOKE_GOLDEN in goldens
+    assert check_golden.DCN_SMOKE_GOLDEN in goldens
     for g in matrix:
         data = json.loads(g.read_text())
         assert "sim_cycle" in data

@@ -1,0 +1,497 @@
+"""tpusim.dcn — the multi-slice DCN fabric layer.
+
+Covers the ISSUE 20 acceptance criteria: single-slice / unconfigured
+fabrics price byte-identically to the flat scalar model, hierarchical
+AR/AG/RS on a 2-slice fixture match hand-computed costs, DCN fault
+kinds flow end-to-end through the campaign and fleet executors
+(slice-survival accounting, partition attribution, fabric-priced
+recovery migration), and the advise ranked table grows dp-over-DCN
+cells whose ordering flips with the fabric bandwidth.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+
+import pytest
+
+from tpusim.dcn import (
+    DcnBlock,
+    DcnFabric,
+    DcnSpecError,
+    SliceTopology,
+    fabric_overlay,
+    slice_topology_for,
+)
+from tpusim.ici.collectives import CollectiveModel
+from tpusim.ici.topology import torus_for
+from tpusim.timing.config import load_config
+
+FIXTURES = Path(__file__).parent / "fixtures" / "traces"
+TRACE = FIXTURES / "llama_tiny_tp2dp2"
+
+MiB = float(1 << 20)
+
+
+def _ici(overlay: dict):
+    return load_config(
+        arch="v5p", overlays=[{"arch": {"ici": overlay}}], tuned=False,
+    ).arch.ici
+
+
+# -- slice topology ----------------------------------------------------------
+
+
+def test_fabric_gated_on_nic_count():
+    # chips_per_slice alone (the pre-fabric flat config) composes NO
+    # fabric — the flat scalar model stays in charge
+    assert slice_topology_for(8, _ici({"chips_per_slice": 4})) is None
+    assert slice_topology_for(8, _ici({})) is None
+    st = slice_topology_for(
+        8, _ici({"chips_per_slice": 4, "dcn_nics_per_slice": 4}),
+    )
+    assert st is not None
+    assert (st.num_slices, st.chips_per_slice) == (2, 4)
+
+
+def test_hop_fields_fall_back_to_flat_scalars():
+    cfg = _ici({"chips_per_slice": 4, "dcn_nics_per_slice": 1})
+    st = slice_topology_for(8, cfg)
+    assert st.nic_bandwidth == cfg.dcn_bandwidth
+    assert st.hop_latency == cfg.dcn_latency
+
+
+def test_slice_geometry():
+    st = SliceTopology(
+        num_slices=2, chips_per_slice=4, nics_per_slice=4,
+        nic_bandwidth=25e9, hop_latency=1e-5, oversubscription=2.0,
+    )
+    assert [st.slice_of(c) for c in range(8)] == [0] * 4 + [1] * 4
+    assert st.slice_bandwidth() == 4 * 25e9 / 2.0
+    assert st.slices_for_group(4) == 1
+    assert st.slices_for_group(5) == 2
+    assert st.slices_for_group(8) == 2
+
+
+def test_fabric_overlay_rounds_slices_up():
+    block = DcnBlock.parse({"num_slices": 3, "nics_per_slice": 2})
+    ov = fabric_overlay(block, 8)
+    # ceil(8/3) = 3 chips per slice so the partial slice still counts
+    assert ov["arch"]["ici"]["chips_per_slice"] == 3
+    assert ov["arch"]["ici"]["dcn_nics_per_slice"] == 2
+
+
+def test_dcn_block_rejections():
+    with pytest.raises(DcnSpecError):
+        DcnBlock.parse({"num_slices": 1})
+    with pytest.raises(DcnSpecError):
+        DcnBlock.parse({"num_slices": 2, "oversubscription": 0})
+    with pytest.raises(DcnSpecError):
+        DcnBlock.parse({"num_slices": 2, "warp_drive": True})
+
+
+# -- degeneration: unconfigured fabric is byte-identical ---------------------
+
+
+def test_unconfigured_fabric_prices_byte_identically():
+    """Setting every dcn_hop_* knob WITHOUT a NIC count composes no
+    fabric: all collective kinds price bit-for-bit as the flat model."""
+    topo = torus_for(8, "v5p")
+    flat = CollectiveModel(topo, _ici({"chips_per_slice": 4}))
+    hopped = CollectiveModel(topo, _ici({
+        "chips_per_slice": 4,
+        "dcn_hop_bandwidth": 25e9, "dcn_hop_latency": 1e-5,
+        "dcn_oversubscription": 2.0,
+    }))
+    for n in (2, 4, 8):
+        for b in (4096.0, 64 * MiB):
+            assert flat.allreduce_seconds(b, n) == \
+                hopped.allreduce_seconds(b, n)
+            assert flat.allgather_seconds(b, n) == \
+                hopped.allgather_seconds(b, n)
+            assert flat.reducescatter_seconds(b, n) == \
+                hopped.reducescatter_seconds(b, n)
+            assert flat.alltoall_seconds(b, n) == \
+                hopped.alltoall_seconds(b, n)
+    pairs = tuple((i, (i + 1) % 8) for i in range(8))
+    assert flat.permute_seconds(4096.0, pairs) == \
+        hopped.permute_seconds(4096.0, pairs)
+
+
+def test_single_slice_group_never_pays_dcn():
+    """A group that fits one slice prices identically with and without
+    the fabric — the hierarchical path only engages past the slice."""
+    topo = torus_for(8, "v5p")
+    flat = CollectiveModel(topo, _ici({}))
+    fab = CollectiveModel(topo, _ici({
+        "chips_per_slice": 4, "dcn_nics_per_slice": 4,
+    }))
+    assert flat.allreduce_seconds(64 * MiB, 4) == \
+        fab.allreduce_seconds(64 * MiB, 4)
+
+
+# -- hierarchical decomposition vs hand-computed costs -----------------------
+
+
+FABRIC_ICI = {
+    "chips_per_slice": 4,
+    "dcn_nics_per_slice": 4,
+    "dcn_hop_bandwidth": 25e9,
+    "dcn_hop_latency": 1e-5,
+}
+W_SLICE = 4 * 25e9  # per-slice injection bandwidth, 2 slices of 4
+
+
+def _models():
+    topo = torus_for(8, "v5p")
+    return (
+        CollectiveModel(topo, _ici({"chips_per_slice": 4})),
+        CollectiveModel(topo, _ici(FABRIC_ICI)),
+    )
+
+
+def test_hierarchical_allreduce_matches_hand_cost():
+    flat, fab = _models()
+    b = 64 * MiB
+    cfg = fab.cfg
+    # in-slice reduce-scatter -> cross-slice ring AR over 2 slices
+    # (2(S-1)/S * B / W + lat*ceil(log2 2)) -> in-slice all-gather
+    cross = 2.0 * (2 - 1) / 2 * b / W_SLICE + 1e-5
+    hier = (
+        fab.reducescatter_seconds(b, 4)
+        + cfg.launch_latency + cross
+        + fab.allgather_seconds(b, 4)
+    )
+    got = fab.allreduce_seconds(b, 8)
+    assert got == pytest.approx(
+        min(flat.allreduce_seconds(b, 8), hier), rel=1e-12,
+    )
+    # at 64 MiB over 4 healthy NICs the hierarchical path wins
+    assert got < flat.allreduce_seconds(b, 8)
+
+
+def test_hierarchical_allgather_and_rs_match_hand_cost():
+    flat, fab = _models()
+    b = 64 * MiB
+    cross = (2 - 1) / 2 * b / W_SLICE + 1e-5
+    hier = (
+        fab.cfg.launch_latency + cross + fab.allgather_seconds(b, 4)
+    )
+    got = fab.allgather_seconds(b, 8)
+    assert got == pytest.approx(
+        min(flat.allgather_seconds(b, 8), hier), rel=1e-12,
+    )
+    # reduce-scatter is the mirrored walk — same cost by construction
+    assert fab.reducescatter_seconds(b, 8) == got
+
+
+def test_hierarchical_alltoall_matches_hand_cost():
+    flat, fab = _models()
+    b = 64 * MiB
+    # each 4-chip slice pushes 4*B*(S-1)/S bytes through its NIC bank
+    cross = (4 * b * (2 - 1) / 2) / W_SLICE + 1e-5
+    hier = (
+        fab.alltoall_seconds(b, 4) + fab.cfg.launch_latency + cross
+    )
+    assert fab.alltoall_seconds(b, 8) == pytest.approx(
+        min(flat.alltoall_seconds(b, 8), hier), rel=1e-12,
+    )
+
+
+def test_tiny_payload_keeps_flat_model():
+    """Per-phase launch latencies make the hierarchy a bad deal for
+    small payloads — min(flat, hier) must keep the flat price."""
+    flat, fab = _models()
+    assert fab.allreduce_seconds(1024.0, 8) == \
+        flat.allreduce_seconds(1024.0, 8)
+
+
+# -- fault-aware fabric ------------------------------------------------------
+
+
+class _View:
+    """Minimal FaultView stand-in (duck-typed by DcnFabric)."""
+
+    def __init__(self, nics_down=None, scales=None, slices_down=()):
+        self.dcn_nics_down = nics_down or {}
+        self.dcn_scales = scales or {}
+        self.slices_down = frozenset(slices_down)
+
+
+def _st(nics=4, oversub=1.0):
+    return SliceTopology(
+        num_slices=2, chips_per_slice=4, nics_per_slice=nics,
+        nic_bandwidth=25e9, hop_latency=1e-5, oversubscription=oversub,
+    )
+
+
+def test_fabric_degradation_semantics():
+    st = _st()
+    assert DcnFabric(st).slice_bandwidth(0) == 4 * 25e9
+    assert DcnFabric(st, _View(nics_down={0: 1})) \
+        .slice_bandwidth(0) == 3 * 25e9
+    assert DcnFabric(st, _View(scales={1: 0.5})) \
+        .slice_bandwidth(1) == 2 * 25e9
+    assert DcnFabric(st, _View(slices_down=[1])).slice_bandwidth(1) == 0.0
+    assert DcnFabric(st, _View(nics_down={0: 4})).slice_bandwidth(0) == 0.0
+
+
+def test_dead_slice_makes_cross_terms_inf_and_flat_caps():
+    fab = DcnFabric(_st(), _View(slices_down=[1]))
+    assert math.isinf(fab.cross_allreduce_seconds(64 * MiB, 2))
+    assert math.isinf(fab.transfer_seconds(1024.0, 1))
+    # ... and the collective model falls back to the flat cap
+    from tpusim.faults import load_fault_schedule
+
+    topo = torus_for(8, "v5p")
+    view = load_fault_schedule(
+        {"faults": [{"kind": "slice_down", "slice": 1}]}
+    ).bind(topo).view_at(0.0)
+    flat = CollectiveModel(topo.with_faults(view), _ici(
+        {"chips_per_slice": 4},
+    ))
+    degraded = CollectiveModel(topo.with_faults(view), _ici(FABRIC_ICI))
+    assert degraded.allreduce_seconds(64 * MiB, 8) == \
+        flat.allreduce_seconds(64 * MiB, 8)
+
+
+def test_nic_loss_slows_the_hierarchical_path():
+    topo = torus_for(8, "v5p")
+    from tpusim.faults import load_fault_schedule
+
+    view = load_fault_schedule(
+        {"faults": [{"kind": "dcn_link_down", "slice": 0},
+                    {"kind": "dcn_link_down", "slice": 0}]}
+    ).bind(topo).view_at(0.0)
+    healthy = CollectiveModel(topo, _ici(FABRIC_ICI))
+    hurt = CollectiveModel(topo.with_faults(view), _ici(FABRIC_ICI))
+    assert hurt.allreduce_seconds(64 * MiB, 8) > \
+        healthy.allreduce_seconds(64 * MiB, 8)
+
+
+# -- driver stats ------------------------------------------------------------
+
+
+def test_driver_stamps_dcn_stats_only_when_spanning():
+    from tpusim.sim.driver import simulate_trace
+
+    healthy = simulate_trace(TRACE, arch="v5p", tuned=False)
+    assert not [
+        k for k in healthy.stats.values if k.startswith("dcn_")
+    ]
+    fab = simulate_trace(
+        TRACE, arch="v5p", tuned=False,
+        overlays=[{"arch": {"ici": {
+            "chips_per_slice": 2, "dcn_nics_per_slice": 2,
+        }}}],
+    )
+    assert fab.stats.get("dcn_slices") == 2
+    assert fab.stats.get("dcn_chips_per_slice") == 2
+    assert fab.stats.get("dcn_slice_bandwidth") == pytest.approx(
+        2 * fab.stats.get("dcn_nics_per_slice") * 25e9 / 2
+    )
+
+
+# -- campaign: DCN faults end-to-end -----------------------------------------
+
+
+def _campaign_spec(**over) -> dict:
+    doc = {
+        "name": "dcn-e2e", "seed": 7, "scenarios": 6,
+        "arch": "v5p", "chips": 4, "tuned": False,
+        "dcn": {"num_slices": 2, "nics_per_slice": 2,
+                "nic_bandwidth": 25e9, "hop_latency": 1e-5},
+        "faults": {
+            "count": {"dist": "uniform", "min": 1, "max": 2},
+            "kinds": {"slice_down": 2.0, "dcn_link_down": 1.0,
+                      "link_degraded": 0.5},
+            "scale": {"min": 0.4, "max": 0.9},
+        },
+    }
+    doc.update(over)
+    return doc
+
+
+def test_campaign_answers_slice_survival():
+    from tpusim.campaign import run_campaign
+
+    res = run_campaign(_campaign_spec(), trace_path=TRACE)
+    sl = res.doc["slices"][0]
+    dcn = sl["dcn"]
+    assert dcn["slices"] == 2
+    assert dcn["slice_loss_scenarios"] >= 1
+    assert 1 <= dcn["min_slices_ok"] <= 2
+    assert sum(dcn["slices_ok_hist"].values()) == sl["scenarios"]
+    # every row carries per-scenario survival, and slice-loss rows are
+    # partition outcomes with the DCN attribution string
+    lost_rows = [
+        r for r in res.doc["rows"] if r["dcn"]["slices_lost"] > 0
+    ]
+    assert lost_rows
+    for r in lost_rows:
+        assert r["status"] == "partitioned"
+        assert "unreachable over the DCN fabric" in r["error"]
+    for r in res.doc["rows"]:
+        assert r["dcn"]["slices_ok"] + r["dcn"]["slices_lost"] == 2
+
+
+def test_campaign_without_dcn_has_no_dcn_keys():
+    from tpusim.campaign import run_campaign
+
+    spec = _campaign_spec()
+    del spec["dcn"]
+    spec["faults"]["kinds"] = {"link_degraded": 1.0}
+    res = run_campaign(spec, trace_path=TRACE)
+    assert all("dcn" not in r for r in res.doc["rows"])
+    assert all("dcn" not in s for s in res.doc["slices"])
+
+
+def test_campaign_dcn_kind_without_fabric_refused():
+    from tpusim.analysis import ValidationError
+    from tpusim.campaign import run_campaign
+
+    spec = _campaign_spec()
+    del spec["dcn"]
+    with pytest.raises((ValidationError, ValueError)) as ei:
+        run_campaign(spec, trace_path=TRACE)
+    assert getattr(ei.value, "code", None) == "TL231" \
+        or "TL231" in str(ei.value)
+
+
+def test_campaign_same_seed_byte_identical_with_dcn():
+    import json
+
+    from tpusim.campaign import run_campaign
+
+    a = run_campaign(_campaign_spec(), trace_path=TRACE)
+    b = run_campaign(_campaign_spec(), trace_path=TRACE)
+    assert json.dumps(a.doc, sort_keys=True) == \
+        json.dumps(b.doc, sort_keys=True)
+
+
+# -- fleet: DCN faults, partition attribution, fabric migration --------------
+
+
+def _fleet_spec(**over) -> dict:
+    doc = {
+        "name": "t-fleet-dcn", "seed": 3, "pods": 2,
+        "arch": "v5p", "chips": 8, "tuned": False,
+        "horizon_s": 30.0,
+        "dcn": {"num_slices": 2, "nics_per_slice": 2,
+                "nic_bandwidth": 25e9, "hop_latency": 1e-5},
+        "traffic": {
+            "load_points": [6.0],
+            "mix": [{"name": "chat", "weight": 3.0, "steps": 50},
+                    {"name": "batch", "weight": 1.0, "steps": 200}],
+        },
+        "faults": {
+            "count": {"dist": "uniform", "min": 1, "max": 2},
+            "kinds": {"slice_down": 2.0, "dcn_link_down": 1.0},
+            "scale": {"min": 0.4, "max": 0.9},
+            "window": {"min_s": 5.0, "max_s": 15.0},
+            "pod_loss": {"prob": 0.0},
+        },
+        "policies": {"max_inflight": 1, "queue_depth": 4,
+                     "deadline_s": 0.5, "restart_backoff_s": 3.0},
+    }
+    doc.update(over)
+    return doc
+
+
+def test_fleet_slice_loss_windows_attribute_to_partition():
+    from tpusim.fleet import run_fleet
+
+    res = run_fleet(_fleet_spec(), trace_path=TRACE)
+    point = res.doc["curve"][0]
+    assert point["losses"]["partition"] > 0
+    # the sampler stamped slice-targeted fault records
+    sigs = "".join(
+        iv["signature"] for pod in res.doc["degradation"]
+        for iv in pod["intervals"]
+    )
+    assert "slice_down" in sigs or "dcn_link_down" in sigs
+
+
+def test_fleet_migration_priced_over_the_modeled_fabric():
+    from tpusim.advise.transform import build_profile
+    from tpusim.fleet import run_fleet
+    from tpusim.trace.format import load_trace
+
+    spec = _fleet_spec()
+    spec["faults"]["pod_loss"] = {"prob": 0.9}
+    res = run_fleet(spec, trace_path=TRACE)
+    assert res.doc["recovery"], "seeded pod losses produced no rows"
+    pb = build_profile(load_trace(TRACE)).param_bytes_total
+    expect = pb / (2 * 25e9) + 1e-5   # healthy NIC bank + one DCN hop
+    for r in res.doc["recovery"]:
+        assert r["migration_s"] == pytest.approx(expect, rel=1e-12)
+
+
+def test_fleet_dcn_kind_without_fabric_refused():
+    from tpusim.fleet.spec import FleetSpecError, load_fleet_spec
+
+    spec = _fleet_spec()
+    del spec["dcn"]
+    with pytest.raises(FleetSpecError) as ei:
+        load_fleet_spec(spec)
+    assert ei.value.code == "TL231"
+
+
+# -- advise: dp-over-DCN cells and the bandwidth crossover -------------------
+
+
+def _advise_spec(nic_bandwidth: float, nics: int) -> dict:
+    return {
+        "name": "dcn-advise", "strategies": ["dp", "dp_tp"],
+        "slices": [{"arch": "v5p", "chips": 8}],
+        "tuned": False,
+        "dcn": {"num_slices": 4, "nics_per_slice": nics,
+                "nic_bandwidth": nic_bandwidth, "hop_latency": 1e-5},
+    }
+
+
+def test_advise_ranks_dp_over_dcn_and_crossover_flips():
+    from tpusim.advise import run_advise
+
+    fast = run_advise(_advise_spec(25e9, 4), trace_path=TRACE)
+    slow = run_advise(_advise_spec(2e8, 1), trace_path=TRACE)
+
+    # the ranked table grew dp-over-DCN x tp-over-ICI cells: dp4xtp2
+    # spans 4 slices of 2 chips on dp while tp stays inside a slice
+    by_cell = {r["cell"]: r for r in fast.doc["cells"]}
+    mixed = by_cell["v5p-8/dp4xtp2"]
+    assert mixed["dcn"] == {
+        "slices": 4, "dp_over_dcn": True, "spanning_axes": ["dp"],
+    }
+    pure = by_cell["v5p-8/dp8"]
+    assert pure["dcn"]["dp_over_dcn"] is True
+
+    # crossover: a fast fabric ranks the all-DCN dp8 mesh first; a slow
+    # fabric flips it below the dp-over-DCN x tp-over-ICI hybrid
+    fast_order = [r["cell"] for r in fast.doc["cells"]]
+    slow_order = [r["cell"] for r in slow.doc["cells"]]
+    assert fast_order.index("v5p-8/dp8") < \
+        fast_order.index("v5p-8/dp4xtp2")
+    assert slow_order.index("v5p-8/dp4xtp2") < \
+        slow_order.index("v5p-8/dp8")
+
+
+def test_advise_without_dcn_rows_unchanged():
+    from tpusim.advise import run_advise
+
+    spec = _advise_spec(25e9, 4)
+    del spec["dcn"]
+    res = run_advise(spec, trace_path=TRACE)
+    assert res.doc["cells"]
+    assert all("dcn" not in r for r in res.doc["cells"])
+
+
+def test_advise_bad_dcn_block_is_tl230():
+    from tpusim.advise.spec import AdviseSpecError, load_advise_spec
+
+    spec = _advise_spec(25e9, 4)
+    spec["dcn"] = {"num_slices": 1}
+    with pytest.raises(AdviseSpecError) as ei:
+        load_advise_spec(spec)
+    assert ei.value.code == "TL230"

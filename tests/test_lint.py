@@ -345,6 +345,9 @@ ENTRY %main (p0: f32[8]) -> f32[8] {
     ("config-compose", {"TL107"}, _config_defect(
         "/nonexistent/overlay.flags",
     )),
+    ("slice-tiling", {"TL108"}, _config_defect(
+        {"arch": {"ici": {"chips_per_slice": 3}}},
+    )),
     ("schedule-window", {"TL201"}, _schedule_defect(
         {"faults": [{"kind": "chip_straggler", "chip": 0,
                      "clock_scale": 0.5,
@@ -384,6 +387,17 @@ ENTRY %main (p0: f32[8]) -> f32[8] {
              {"name": "ghost-bundle", "prob": 0.5,
               "links": [[[0, 0, 0], [2, 0, 0]]]},
          ]},
+    )),
+    ("dcn-bad-block", {"TL230"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4, "dcn": {"num_slices": 1}},
+    )),
+    ("dcn-kind-without-fabric", {"TL231"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4,
+         "faults": {"kinds": ["slice_down"]}},
+    )),
+    ("dcn-geometry", {"TL232"}, _campaign_defect(
+        {"seed": 1, "scenarios": 4, "chips": 4,
+         "dcn": {"num_slices": 8}},
     )),
     ("advise-unknown-field", {"TL220"}, _advise_defect(
         {"strategies": ["dp"], "warp_drive": True},

@@ -267,7 +267,7 @@ def run_advise(
     dropped = max(len(cells) - spec.max_cells, 0)
     cells = cells[: spec.max_cells]
 
-    cfg_cache: dict[str, object] = {}
+    cfg_cache: dict[tuple, object] = {}
     module_cache: dict[tuple[str, float], object] = {}
     # scaled-module exposed-collective cycles, memoized per
     # (module variant, arch) — analyze_module_perf is pure
@@ -300,11 +300,22 @@ def run_advise(
             })
             continue
 
-        cfg = cfg_cache.get(cell.sl.arch)
+        # the fabric overlay sizes chips_per_slice from the cell's chip
+        # count, so configs key on (arch, chips) when a dcn block rides
+        ckey = (
+            (cell.sl.arch, cell.sl.chips) if spec.dcn is not None
+            else (cell.sl.arch,)
+        )
+        cfg = cfg_cache.get(ckey)
         if cfg is None:
-            cfg = cfg_cache[cell.sl.arch] = load_config(
+            overlays: list[dict] = [{"power_enabled": True}]
+            if spec.dcn is not None:
+                from tpusim.dcn.spec import fabric_overlay
+
+                overlays.append(fabric_overlay(spec.dcn, cell.sl.chips))
+            cfg = cfg_cache[ckey] = load_config(
                 arch=cell.sl.arch,
-                overlays=[{"power_enabled": True}],
+                overlays=overlays,
                 tuned=spec.tuned,
             )
         pp = degrees.get("pp", 1)
@@ -344,7 +355,7 @@ def run_advise(
             energy = report.power.total_joules
         resident_gib = _residency_gib(compute)
         fits_hbm = resident_gib <= cfg.arch.hbm_gib
-        pkey = (mkey, cell.sl.arch)
+        pkey = (mkey, ckey)
         module_exposed = perf_cache.get(pkey)
         if module_exposed is None:
             from tpusim.analysis.critpath import analyze_module_perf
@@ -387,6 +398,24 @@ def run_advise(
             "slo_ok": slo_ok,
             "feasible": fits_hbm and slo_ok is not False,
         }
+        if spec.dcn is not None:
+            from tpusim.dcn import slice_topology_for
+
+            st = slice_topology_for(cell.sl.chips, cfg.arch.ici)
+            if st is not None:
+                # an axis "spans" the DCN when its collective group
+                # outgrows one slice — the group then prices
+                # hierarchically (or over the flat scalar term,
+                # whichever is cheaper)
+                row["dcn"] = {
+                    "slices": st.num_slices,
+                    "dp_over_dcn":
+                        degrees.get("dp", 1) > st.chips_per_slice,
+                    "spanning_axes": sorted(
+                        k for k, v in degrees.items()
+                        if v > st.chips_per_slice
+                    ),
+                }
         rows.append(row)
         if row["feasible"]:
             stats.feasible += 1

@@ -22,6 +22,13 @@ Spec document::
       "slo": {"step_time_ms": 1.0}
     }
 
+The optional ``dcn`` block (:mod:`tpusim.dcn.spec`) stands a modeled
+multi-slice DCN fabric up over every candidate slice: mesh axes whose
+collective groups outgrow one TPU slice then price hierarchically over
+the fabric (dp-over-DCN x tp-over-ICI cells), each ranked row carries a
+``dcn`` field naming its spanning axes, and the dp/tp crossover falls
+out of the ranking as ``nic_bandwidth`` moves.
+
 ``strategies`` names the families to enumerate (``dp`` pure data
 parallel, ``tp`` pure tensor parallel, ``dp_tp`` every composite
 dp x tp factorization of the slice, ``sp`` ring-attention sequence
@@ -207,6 +214,9 @@ class AdviseSpec:
     tuned: bool
     max_cells: int
     slo: SloSpec | None
+    #: the modeled multi-slice DCN fabric (None = single slice) — a
+    #: :class:`tpusim.dcn.DcnBlock`
+    dcn: object | None = None
     #: the raw document, canonicalized — :func:`spec_hash` identity
     doc: dict = field(repr=False, hash=False, compare=False,
                       default_factory=dict)
@@ -226,7 +236,7 @@ class AdviseSpec:
 
 _TOP_FIELDS = {
     "name", "strategies", "slices", "meshes", "microbatches", "tuned",
-    "max_cells", "slo",
+    "max_cells", "slo", "dcn",
 }
 
 
@@ -312,6 +322,15 @@ def load_advise_spec(src) -> AdviseSpec:
         f"got {max_cells!r}",
     )
 
+    dcn = None
+    if doc.get("dcn") is not None:
+        from tpusim.dcn.spec import DcnBlock, DcnSpecError
+
+        try:
+            dcn = DcnBlock.parse(doc["dcn"])
+        except DcnSpecError as e:
+            raise AdviseSpecError(str(e), code="TL230") from e
+
     slo = SloSpec.parse(doc["slo"]) if doc.get("slo") is not None else None
     _require(
         slo is None or slices_doc is None or bool(slices),
@@ -323,7 +342,7 @@ def load_advise_spec(src) -> AdviseSpec:
     return AdviseSpec(
         name=name, strategies=tuple(strategies), slices=slices,
         meshes=meshes, microbatches=microbatches, tuned=tuned,
-        max_cells=max_cells, slo=slo, doc=doc,
+        max_cells=max_cells, slo=slo, dcn=dcn, doc=doc,
     )
 
 

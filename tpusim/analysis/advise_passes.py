@@ -39,7 +39,10 @@ def run_advise_passes(
     * TL222 — a pinned mesh whose axis product factors none of the
       candidate slices (it would never produce a priceable cell);
     * TL223 — a candidate slice naming an arch with no preset;
-    * TL224 — an SLO with explicitly empty candidate slices.
+    * TL224 — an SLO with explicitly empty candidate slices;
+    * TL230 — surfaced from the loader (malformed ``dcn`` block);
+    * TL232 — fabric geometry no candidate slice can stand up
+      (:func:`tpusim.analysis.dcn_passes.run_dcn_passes`).
     """
     from tpusim.advise.spec import AdviseSpecError, load_advise_spec
     from tpusim.timing.arch import ARCH_PRESETS
@@ -51,6 +54,12 @@ def run_advise_passes(
         return
 
     slices = spec.resolved_slices(default_chips)
+    if spec.dcn is not None:
+        from tpusim.analysis.dcn_passes import run_dcn_passes
+
+        for sl in slices:
+            run_dcn_passes(spec.dcn, diags, num_chips=sl.chips,
+                           file=file)
     chip_counts = set()
     for sl in slices:
         if sl.arch.lower() not in ARCH_PRESETS:

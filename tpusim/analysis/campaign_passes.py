@@ -40,7 +40,11 @@ def run_campaign_passes(
       SLO without candidates);
     * TL212 — SLO percentile outside (0, 100];
     * TL213 — correlated group referencing links/axes the slice torus
-      does not have.
+      does not have;
+    * TL230/TL231 — surfaced from the loader (malformed ``dcn`` block /
+      DCN fault kinds without a fabric);
+    * TL232 — fabric geometry the candidate shapes cannot stand up
+      (:func:`tpusim.analysis.dcn_passes.run_dcn_passes`).
     """
     from tpusim.campaign.spec import CampaignSpecError, load_campaign_spec
     from tpusim.ici.topology import torus_for
@@ -51,6 +55,13 @@ def run_campaign_passes(
     except CampaignSpecError as e:
         diags.emit(e.code, str(e), file=file)
         return
+
+    if spec.dcn is not None:
+        from tpusim.analysis.dcn_passes import run_dcn_passes
+
+        for sl in spec.slices(default_chips):
+            run_dcn_passes(spec.dcn, diags, num_chips=sl.chips,
+                           file=file)
 
     for sl in spec.slices(default_chips):
         try:

@@ -183,6 +183,31 @@ def _check_trace_agreement(
         )
 
 
+def _check_slice_tiling(
+    cfg: SimConfig, trace_meta: dict, diags: Diagnostics,
+    file: str | None,
+) -> None:
+    """TL108: a ``chips_per_slice`` that does not evenly tile the
+    trace's chip count prices silently through ``math.ceil`` — the
+    partial last slice participates in the DCN ring as a FULL slice
+    (``S = ceil(chips / chips_per_slice)``), which is usually a typo
+    in one of the two numbers."""
+    cps = cfg.arch.ici.chips_per_slice
+    if not _is_number(cps) or cps <= 0:
+        return
+    chips = int(trace_meta.get("num_devices", 0) or 0)
+    if chips > cps and chips % cps:
+        s = math.ceil(chips / cps)
+        diags.emit(
+            "TL108",
+            f"chips_per_slice={cps} does not evenly tile the trace's "
+            f"{chips} chips — the collective model rounds UP to "
+            f"{s} slices and prices the {chips % cps}-chip partial "
+            f"slice as a full DCN participant",
+            file=file,
+        )
+
+
 def run_config_passes(
     cfg: SimConfig,
     diags: Diagnostics,
@@ -197,3 +222,4 @@ def run_config_passes(
     _check_rooflines(cfg, diags, file)
     if trace_meta:
         _check_trace_agreement(cfg, trace_meta, diags, file)
+        _check_slice_tiling(cfg, trace_meta, diags, file)

@@ -101,6 +101,8 @@ _code("TL106", _E, "config field must be non-negative (latency/cycle "
                    "count)")
 _code("TL107", _E, "config does not compose (unknown preset, missing "
                    "or unparseable overlay)")
+_code("TL108", _W, "chips_per_slice does not evenly tile the chip count "
+                   "(the partial slice prices as a full one)")
 
 # --- schedule passes (TL2xx) -----------------------------------------------
 _code("TL201", _E, "fault schedule fails format/window validation")
@@ -125,6 +127,14 @@ _code("TL222", _E, "pinned mesh shape does not factor any candidate "
                    "slice's chip count")
 _code("TL223", _E, "advise candidate slice names an arch with no preset")
 _code("TL224", _E, "advise SLO given without candidate slices to rank")
+
+# --- dcn passes (TL23x) ----------------------------------------------------
+_code("TL230", _E, "dcn block fails format validation (bad field, type, "
+                   "or range)")
+_code("TL231", _E, "DCN fault kinds sampled without a configured dcn "
+                   "fabric")
+_code("TL232", _W, "DCN fault targets a slice index outside the "
+                   "configured fabric")
 
 # --- fleet passes (TL24x) --------------------------------------------------
 _code("TL240", _E, "fleet spec fails format validation (bad field, "
@@ -321,6 +331,7 @@ CODE_FAMILIES: tuple[tuple[str, str, str], ...] = (
     ("TL20", "schedule passes", "tpusim/analysis/schedule_passes.py"),
     ("TL21", "campaign passes", "tpusim/analysis/campaign_passes.py"),
     ("TL22", "advise passes", "tpusim/analysis/advise_passes.py"),
+    ("TL23", "dcn passes", "tpusim/analysis/dcn_passes.py"),
     ("TL24", "fleet passes", "tpusim/analysis/fleet_passes.py"),
     ("TL30", "stats-key contract", "tpusim/analysis/statskeys.py"),
     ("TL35", "self-audit passes", "tpusim/analysis/selfaudit.py"),

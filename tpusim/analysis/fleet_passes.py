@@ -41,7 +41,11 @@ def run_fleet_passes(
     * TL242 — SLO/frontier violations (percentile outside (0, 100],
       frontier without an SLO);
     * TL243 — correlated group referencing links/axes the pod torus
-      does not have.
+      does not have;
+    * TL230/TL231 — surfaced from the loader (malformed ``dcn`` block /
+      DCN fault kinds without a fabric);
+    * TL232 — fabric geometry the pod shape cannot stand up
+      (:func:`tpusim.analysis.dcn_passes.run_dcn_passes`).
     """
     from tpusim.campaign.spec import CampaignSpecError
     from tpusim.fleet.spec import FleetSpecError, load_fleet_spec
@@ -64,6 +68,10 @@ def run_fleet_passes(
         )
         return
     chips = spec.chips or default_chips
+    if spec.dcn is not None:
+        from tpusim.analysis.dcn_passes import run_dcn_passes
+
+        run_dcn_passes(spec.dcn, diags, num_chips=chips, file=file)
     topo = torus_for(chips, arch_name)
     for g in spec.groups:
         try:

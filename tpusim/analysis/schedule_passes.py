@@ -74,9 +74,11 @@ def run_schedule_passes(
         )
         return
 
+    from tpusim.faults.schedule import _DCN_KINDS, FAULT_KINDS
+
     bound = state.bound_faults()
     for i, (fault, where) in enumerate(bound):
-        if fault.scale == 1.0 and fault.kind != "link_down":
+        if fault.scale == 1.0 and FAULT_KINDS[fault.kind] is not None:
             diags.emit(
                 "TL204",
                 f"fault[{i}]: {fault.kind} with scale 1.0 has no "
@@ -85,6 +87,10 @@ def run_schedule_passes(
             )
     by_entity: dict[tuple, list[tuple[int, object, frozenset]]] = {}
     for i, (fault, where) in enumerate(bound):
+        if fault.kind == "dcn_link_down":
+            # each record is a DISTINCT NIC of the slice — overlapping
+            # records stack by design (k NICs down), never a conflict
+            continue
         by_entity.setdefault(_entity_key(fault, where), []).append(
             (i, fault, _directions(fault, where))
         )
@@ -99,10 +105,12 @@ def run_schedule_passes(
                     # opposite directions of the same cable are two
                     # physical links — no stacking
                     continue
-                what = (
-                    f"link {key[1]}" if key[0] == "link"
-                    else f"{key[0]} on chip {key[1]}"
-                )
+                if key[0] == "link":
+                    what = f"link {key[1]}"
+                elif key[0] in _DCN_KINDS:
+                    what = f"{key[0]} on slice {key[1]}"
+                else:
+                    what = f"{key[0]} on chip {key[1]}"
                 diags.emit(
                     "TL203",
                     f"fault[{i}] and fault[{j}] overlap on {what} "

@@ -144,6 +144,21 @@ STATS_NAMESPACES: dict[str, tuple[str, ...]] = {
         "tpusim/obs/", "tpusim/serve/", "tpusim/__main__.py",
         "ci/check_golden.py",
     ),
+    # the multi-slice DCN fabric (tpusim.dcn): a shared FIELD FAMILY by
+    # design — the DCN fault kinds (dcn_link_down/dcn_link_degraded)
+    # named by the faults schema and samplers, the config knobs the
+    # fabric overlay writes (dcn_nics_per_slice/dcn_hop_bandwidth/...),
+    # the fleet recovery back-compat knob (dcn_gbps), and the driver's
+    # dcn_* report block (stamped ONLY when a fabric is configured and
+    # the pod spans slices — fabric-less runs stay key-identical) carry
+    # one prefix with one meaning across the dcn, faults, campaign, and
+    # fleet packages
+    "dcn_": (
+        "tpusim/dcn/", "tpusim/faults/", "tpusim/campaign/",
+        "tpusim/fleet/", "tpusim/advise/", "tpusim/sim/driver.py",
+        "tpusim/__main__.py", "ci/check_golden.py",
+        "ci/faults_schema.json",
+    ),
     # the multi-node cluster (PR 17, tpusim.serve.cluster): membership
     # epoch + join/beat/death/stale-rejoin counters and the forwarding/
     # shed accounting, exported on /metrics ONLY when the daemon is
@@ -188,7 +203,7 @@ DOCUMENTED_UPDATE_PREFIXES = frozenset(
 #: namespaces whose keys are shared FIELD FAMILIES by design (many
 #: writers, one meaning) and therefore exempt from the one-writer
 #: collision audit; every other registered namespace is owned
-SHARED_FIELD_FAMILIES = frozenset({"ici_"})
+SHARED_FIELD_FAMILIES = frozenset({"ici_", "dcn_"})
 
 #: single-writer namespaces for the collision pass — derived from the
 #: registry so a newly registered prefix is audited automatically
@@ -204,6 +219,7 @@ AUDIT_GLOBS = (
     "tpusim/obs/*.py",
     "tpusim/faults/*.py",
     "tpusim/ici/*.py",
+    "tpusim/dcn/*.py",
     "tpusim/perf/*.py",
     "tpusim/fastpath/*.py",
     "tpusim/serve/*.py",

@@ -73,6 +73,25 @@ def _slice_section(sl_doc: dict, rows: list[dict], slo) -> dict:
             r["watts"] for r in ok if r.get("watts") is not None
         ]),
     }
+    dcn_rows = [r["dcn"] for r in rows if "dcn" in r]
+    if dcn_rows:
+        # slice-survival distribution over the WHOLE sampled population
+        # (rows carry "dcn" only when the spec configured a fabric, so
+        # legacy reports keep their exact byte shape)
+        loss = sum(1 for d in dcn_rows if d["slices_lost"] > 0)
+        hist: dict[str, int] = {}
+        for d in dcn_rows:
+            k = str(d["slices_ok"])
+            hist[k] = hist.get(k, 0) + 1
+        out["dcn"] = {
+            "slices": max(d["slices"] for d in dcn_rows),
+            "slice_loss_scenarios": loss,
+            "slice_loss_rate": loss / len(dcn_rows),
+            "min_slices_ok": min(d["slices_ok"] for d in dcn_rows),
+            "slices_ok_hist": {
+                k: hist[k] for k in sorted(hist, key=int)
+            },
+        }
     if slo is not None:
         # the SLO percentile ranks over ALL scenarios; a scenario with
         # no step time (partition / hard failure) ranks as +inf

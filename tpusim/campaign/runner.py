@@ -27,6 +27,7 @@ moving on.  The three contracts:
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from dataclasses import dataclass, field
@@ -140,19 +141,75 @@ def _disconnected(topo, view, replay_chips: int) -> bool:
     return not want <= seen
 
 
-def _schedule_partitions(state, replay_chips: int) -> bool:
+def _dcn_lost_slices(
+    view, dcn, num_chips: int, replay_chips: int,
+) -> tuple[list[int], int]:
+    """Participating TPU slices this view takes out, plus the
+    participating-slice count.  A slice is lost when ``slice_down``
+    kills its chips outright, or — only when the job actually spans
+    slices — when every one of its DCN NICs is dead (``dcn_link_down``
+    records stack per-NIC)."""
+    cps = max(math.ceil(num_chips / dcn.num_slices), 1)
+    s_count = min(math.ceil(replay_chips / cps), dcn.num_slices)
+    lost = []
+    for s in range(s_count):
+        if s in view.slices_down:
+            lost.append(s)
+        elif s_count > 1 and \
+                view.dcn_nics_down.get(s, 0) >= dcn.nics_per_slice:
+            lost.append(s)
+    return lost, s_count
+
+
+def _dcn_row(state, dcn, num_chips: int, replay_chips: int) -> dict:
+    """The per-scenario slice-survival block (``row["dcn"]``): how many
+    TPU slices participate, and how many are lost at ANY point in the
+    schedule — the numbers the report's ``dcn`` section aggregates to
+    answer "how many slices survive this degradation model"."""
+    boundaries = {0.0}
+    if state.windowed:
+        boundaries.update(f.start_cycle for f, _ in state.bound_faults())
+    lost: set[int] = set()
+    s_count = 0
+    for b in sorted(boundaries):
+        ls, s_count = _dcn_lost_slices(
+            state.view_at(b), dcn, num_chips, replay_chips,
+        )
+        lost.update(ls)
+    return {
+        "slices": s_count,
+        "slices_lost": len(lost),
+        "slices_ok": s_count - len(lost),
+    }
+
+
+def _schedule_partitions(
+    state, replay_chips: int, dcn=None, num_chips: int = 0,
+) -> str | None:
     """Partition test for one bound schedule: any activation window
     whose live-link graph disconnects the replaying chips counts (view
-    sets only change at fault start cycles)."""
+    sets only change at fault start cycles), as does any window that
+    loses a whole participating TPU slice when a DCN fabric is
+    configured.  Returns the attribution string (the row's ``error``
+    field), None when connected throughout."""
     topo = state.topo
-    if not state.windowed:
-        return _disconnected(topo, state.view_at(0.0), replay_chips)
     boundaries = {0.0}
-    boundaries.update(f.start_cycle for f, _ in state.bound_faults())
-    return any(
-        _disconnected(topo, state.view_at(b), replay_chips)
-        for b in sorted(boundaries)
-    )
+    if state.windowed:
+        boundaries.update(f.start_cycle for f, _ in state.bound_faults())
+    for b in sorted(boundaries):
+        view = state.view_at(b)
+        if _disconnected(topo, view, replay_chips):
+            return "dead links disconnect replaying chips"
+        if dcn is not None:
+            lost, s_count = _dcn_lost_slices(
+                view, dcn, num_chips, replay_chips,
+            )
+            if lost:
+                return (
+                    f"slice loss: slice(s) {lost} of {s_count} "
+                    f"unreachable over the DCN fabric"
+                )
+    return None
 
 
 def _price(pod, cfg, topo, faults, cache, workers):
@@ -175,7 +232,7 @@ def _price(pod, cfg, topo, faults, cache, workers):
 def _warm_slice(
     spec: CampaignSpec, pod, cfg, topo, slice_label: str, indices,
     cache, batch_stats, *, backend, cancel, replay_chips: int,
-    check_partition: bool,
+    check_partition: bool, dcn=None,
 ) -> None:
     """Scenario-batched cache warm for one slice: re-sample every
     pending scenario's schedule (pure substream functions — the rows
@@ -200,7 +257,7 @@ def _warm_slice(
             sched_doc = sample_schedule_doc(spec, topo, slice_label, i)
             state = load_fault_schedule(sched_doc).bind(topo)
             if check_partition and _schedule_partitions(
-                state, replay_chips
+                state, replay_chips, dcn=dcn, num_chips=topo.num_chips,
             ):
                 continue  # becomes a partitioned row, never priced
             states.append(state)
@@ -218,7 +275,7 @@ def _warm_slice(
 def _run_scenario(
     spec: CampaignSpec, pod, cfg, topo, slice_label: str, index: int,
     healthy: dict, cache, workers, stats: CampaignStats,
-    replay_chips: int, check_partition: bool,
+    replay_chips: int, check_partition: bool, dcn=None,
     sleep=time.sleep,
 ) -> tuple[dict, dict]:
     """Price scenario ``index``: returns ``(row, schedule_doc)``.
@@ -236,15 +293,24 @@ def _run_scenario(
         "num_faults": len(sched_doc["faults"]),
     }
     sched = load_fault_schedule(sched_doc)
-    if check_partition and _schedule_partitions(
-        sched.bind(topo), replay_chips
-    ):
-        stats.partitioned += 1
-        row.update({
-            "status": "partitioned", "partitioned": True,
-            "error": "dead links disconnect replaying chips",
-        })
-        return row, sched_doc
+    state = sched.bind(topo) if (check_partition or dcn is not None) \
+        else None
+    if dcn is not None:
+        # slice-survival accounting rides EVERY outcome row (ok /
+        # partitioned / failed) so the report can distribute over the
+        # whole sampled population, not just the rows that priced
+        row["dcn"] = _dcn_row(state, dcn, topo.num_chips, replay_chips)
+    if check_partition:
+        reason = _schedule_partitions(
+            state, replay_chips, dcn=dcn, num_chips=topo.num_chips,
+        )
+        if reason:
+            stats.partitioned += 1
+            row.update({
+                "status": "partitioned", "partitioned": True,
+                "error": reason,
+            })
+            return row, sched_doc
     attempts = 0
     while True:
         attempts += 1
@@ -448,8 +514,17 @@ def run_campaign(
             if cancel is not None:
                 cancel.check()
             stats.slices += 1
+            overlays = [{"power_enabled": True}]
+            if spec.dcn is not None:
+                # stand the modeled DCN fabric up over this candidate
+                # shape: the collective model's hierarchical
+                # decomposition and the flat scalar tail both read the
+                # overlaid arch.ici.* fields
+                from tpusim.dcn.spec import fabric_overlay
+
+                overlays.append(fabric_overlay(spec.dcn, sl.chips))
             cfg = load_config(
-                arch=sl.arch, overlays=[{"power_enabled": True}],
+                arch=sl.arch, overlays=overlays,
                 tuned=spec.tuned,
             )
             topo = torus_for(sl.chips, cfg.arch.name)
@@ -483,6 +558,7 @@ def run_campaign(
                         cancel=cancel,
                         replay_chips=min(default_chips, topo.num_chips),
                         check_partition=check_partition,
+                        dcn=spec.dcn,
                     )
             slices_doc.append({
                 "label": sl.label,
@@ -514,6 +590,7 @@ def run_campaign(
                     workers, stats,
                     replay_chips=min(default_chips, topo.num_chips),
                     check_partition=check_partition,
+                    dcn=spec.dcn,
                     sleep=sleep,
                 )
                 if journal is not None:

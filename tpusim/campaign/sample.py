@@ -2,7 +2,10 @@
 
 One scenario = one :mod:`tpusim.faults` schedule document sampled from a
 :class:`~tpusim.campaign.spec.CampaignSpec`'s fault model against a
-concrete torus.  Reproducibility contract: scenario ``i`` of slice ``L``
+concrete torus.  ("Slice" throughout this module means a campaign
+slice — one candidate pod shape's label — NOT a TPU hardware slice;
+the latter only appear as the ``slice`` index of sampled DCN fault
+records.)  Reproducibility contract: scenario ``i`` of slice ``L``
 under seed ``S`` draws from its own ``random.Random(f"{S}:{L}:{i}")``
 substream, so
 
@@ -24,7 +27,7 @@ from __future__ import annotations
 import random
 
 from tpusim.campaign.spec import CampaignSpec
-from tpusim.faults.schedule import FAULT_KINDS, _LINK_KINDS
+from tpusim.faults.schedule import FAULT_KINDS, _DCN_KINDS, _LINK_KINDS
 
 __all__ = ["sample_schedule_doc", "scenario_rng"]
 
@@ -66,10 +69,18 @@ def sample_schedule_doc(
                 })
 
     links = topo.undirected_links()
+    num_slices = spec.dcn.num_slices if spec.dcn is not None else 0
     n = fm.count.sample(rng)
     for _ in range(n):
         kind = _weighted_kind(rng, fm.kinds)
-        if kind in _LINK_KINDS:
+        if kind in _DCN_KINDS:
+            # DCN faults target a TPU hardware slice of the configured
+            # fabric (spec validation guarantees a dcn block exists
+            # when these kinds have weight — TL231)
+            if num_slices <= 1:
+                continue
+            rec = {"kind": kind, "slice": rng.randrange(num_slices)}
+        elif kind in _LINK_KINDS:
             if not links:
                 # a 1-chip slice has no ICI links: the draw lands on a
                 # fault that cannot exist there, so the record is

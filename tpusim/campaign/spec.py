@@ -43,6 +43,15 @@ together: when present, the campaign answers "what is the smallest
 candidate slice that still meets ``step_time_ms`` at ``percentile``
 under this degradation model?".
 
+Naming caveat: ``candidate_slices`` are campaign "slices" — pod-SIZE
+variants of one campaign (the key predates the multi-slice fabric and
+is kept for back-compat).  TPU hardware slices are configured by the
+optional ``dcn`` block (:mod:`tpusim.dcn.spec`: ``num_slices``,
+``nics_per_slice``, ``nic_bandwidth``, ``hop_latency``,
+``oversubscription``), which stands up a modeled DCN fabric over every
+candidate shape and is required before ``faults.kinds`` may sample the
+DCN kinds (``dcn_link_down``/``dcn_link_degraded``/``slice_down``).
+
 Validation raises :class:`CampaignSpecError` carrying a stable TL2xx
 diagnostic code (``TL210`` format, ``TL211`` candidate slices, ``TL212``
 SLO percentile) so the static analyzer
@@ -387,8 +396,15 @@ class SloSpec:
 
 @dataclass(frozen=True)
 class CampaignSpec:
-    """A validated campaign: the sampling model plus the slices to
-    price it on."""
+    """A validated campaign: the sampling model plus the candidate pod
+    shapes to price it on.
+
+    Terminology: campaign "slices" (:class:`SliceSpec`,
+    ``candidate_slices``) are pod-SIZE variants of one campaign — a
+    naming that predates the multi-slice fabric and is kept for spec
+    back-compat.  TPU hardware slices (ICI domains joined by DCN) are
+    the ``dcn`` block's ``num_slices``; see the glossary in
+    docs/ARCHITECTURE.md."""
 
     name: str
     seed: int
@@ -402,6 +418,9 @@ class CampaignSpec:
     backoff_s: float
     slo: SloSpec | None
     candidates: tuple[SliceSpec, ...]
+    #: the modeled multi-slice DCN fabric (None = single slice / flat
+    #: scalar model) — a :class:`tpusim.dcn.DcnBlock`
+    dcn: object | None = None
     #: the raw document, canonicalized — the identity :func:`spec_hash`
     #: and the journal header are computed from
     doc: dict = field(repr=False, hash=False, compare=False,
@@ -426,7 +445,7 @@ class CampaignSpec:
 _TOP_FIELDS = {
     "name", "seed", "scenarios", "arch", "chips", "tuned", "faults",
     "correlated_groups", "retries", "backoff_s", "slo",
-    "candidate_slices",
+    "candidate_slices", "dcn",
 }
 
 
@@ -487,6 +506,24 @@ def load_campaign_spec(src) -> CampaignSpec:
     _require(isinstance(tuned, bool),
              f"'tuned' must be a boolean, got {tuned!r}")
     faults = FaultModel.parse(doc.get("faults"))
+    dcn = None
+    if doc.get("dcn") is not None:
+        from tpusim.dcn.spec import DcnBlock, DcnSpecError
+
+        try:
+            dcn = DcnBlock.parse(doc["dcn"])
+        except DcnSpecError as e:
+            raise CampaignSpecError(str(e), code="TL230") from e
+    from tpusim.faults.schedule import _DCN_KINDS
+
+    dcn_kinds = [k for k, _w in faults.kinds if k in _DCN_KINDS]
+    _require(
+        not dcn_kinds or dcn is not None,
+        f"faults.kinds samples DCN fault kind(s) {dcn_kinds} but the "
+        f"spec has no 'dcn' block — a DCN fault needs a configured "
+        f"fabric to degrade",
+        code="TL231",
+    )
     groups_doc = doc.get("correlated_groups", [])
     _require(isinstance(groups_doc, list),
              f"'correlated_groups' must be a list, got {groups_doc!r}")
@@ -529,7 +566,7 @@ def load_campaign_spec(src) -> CampaignSpec:
         name=name, seed=seed, scenarios=scenarios, arch=arch,
         chips=chips, tuned=tuned, faults=faults, groups=groups,
         retries=retries, backoff_s=float(backoff_s), slo=slo,
-        candidates=candidates, doc=doc,
+        candidates=candidates, dcn=dcn, doc=doc,
     )
 
 

@@ -1,0 +1,20 @@
+"""tpusim.dcn — the multi-slice DCN fabric layer.
+
+Sits above :mod:`tpusim.ici` the way DCN sits above ICI in hardware:
+slices are ICI domains (the existing torus, unchanged), and this
+package models what joins them — per-slice NIC banks into an optionally
+oversubscribed spine.  See docs/ARCHITECTURE.md § "Multi-slice fabric".
+"""
+
+from tpusim.dcn.fabric import DcnFabric
+from tpusim.dcn.spec import DcnBlock, DcnSpecError, fabric_overlay
+from tpusim.dcn.topology import SliceTopology, slice_topology_for
+
+__all__ = [
+    "DcnBlock",
+    "DcnFabric",
+    "DcnSpecError",
+    "SliceTopology",
+    "fabric_overlay",
+    "slice_topology_for",
+]

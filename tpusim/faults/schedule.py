@@ -15,15 +15,23 @@ Schedule document::
         {"kind": "link_degraded",  "src": 0, "dst": 1, "bandwidth_scale": 0.5},
         {"kind": "chip_straggler", "chip": [1,1,0], "clock_scale": 0.8},
         {"kind": "hbm_throttle",   "chip": 5, "hbm_scale": 0.6,
-         "start_cycle": 0, "end_cycle": 1e9}
+         "start_cycle": 0, "end_cycle": 1e9},
+        {"kind": "dcn_link_down",  "slice": 1},
+        {"kind": "dcn_link_degraded", "slice": 0, "bandwidth_scale": 0.5},
+        {"kind": "slice_down",     "slice": 1}
     ]}
 
 Chips and link endpoints are either flat chip ids or coordinate lists;
-link faults hit both directions unless ``"directed": true``.  All scale
-multipliers are in ``(0, 1]`` (1.0 = healthy); windows are half-open
-``[start_cycle, end_cycle)`` in device cycles, defaulting to the whole
-run.  The machine-checked contract lives in ``ci/faults_schema.json``
-(validated by ``ci/check_golden.py --faults-smoke``).
+link faults hit both directions unless ``"directed": true``.  DCN fault
+kinds (``dcn_link_down`` = one NIC lost, ``dcn_link_degraded`` = a
+slice's spine bandwidth derated, ``slice_down`` = the whole slice's DCN
+reachability gone) target a TPU *slice* index instead of a chip — they
+only change pricing when a DCN fabric is modeled (:mod:`tpusim.dcn`).
+All scale multipliers are in ``(0, 1]`` (1.0 = healthy); windows are
+half-open ``[start_cycle, end_cycle)`` in device cycles, defaulting to
+the whole run.  The machine-checked contract lives in
+``ci/faults_schema.json`` (validated by ``ci/check_golden.py
+--faults-smoke``).
 
 Three layers:
 
@@ -60,10 +68,14 @@ FAULT_KINDS = {
     "link_degraded": "bandwidth_scale",
     "chip_straggler": "clock_scale",
     "hbm_throttle": "hbm_scale",
+    "dcn_link_down": None,
+    "dcn_link_degraded": "bandwidth_scale",
+    "slice_down": None,
 }
 
 _LINK_KINDS = ("link_down", "link_degraded")
 _CHIP_KINDS = ("chip_straggler", "hbm_throttle")
+_DCN_KINDS = ("dcn_link_down", "dcn_link_degraded", "slice_down")
 
 
 class FaultScheduleError(ValueError):
@@ -84,6 +96,7 @@ class Fault:
     src: object = None          # link endpoint (chip id or coords)
     dst: object = None
     chip: object = None         # chip faults
+    slice: object = None        # DCN faults target a TPU slice index
     scale: float = 1.0          # bandwidth/clock/HBM multiplier
     start_cycle: float = 0.0
     end_cycle: float = math.inf
@@ -128,7 +141,7 @@ def _parse_fault(i: int, rec: dict) -> Fault:
                 f"fault[{i}]: {scale_key} must be in (0, 1], "
                 f"got {scale!r}"
             )
-    src = dst = chip = None
+    src = dst = chip = slice_ = None
     if kind in _LINK_KINDS:
         known.update(("src", "dst", "directed"))
         for k in ("src", "dst"):
@@ -136,6 +149,17 @@ def _parse_fault(i: int, rec: dict) -> Fault:
                 raise FaultScheduleError(f"fault[{i}]: {kind} requires {k!r}")
         src, dst = _parse_endpoint(i, "src", rec["src"]), \
             _parse_endpoint(i, "dst", rec["dst"])
+    elif kind in _DCN_KINDS:
+        known.add("slice")
+        if "slice" not in rec:
+            raise FaultScheduleError(f"fault[{i}]: {kind} requires 'slice'")
+        slice_ = rec["slice"]
+        if not isinstance(slice_, int) or isinstance(slice_, bool) \
+                or slice_ < 0:
+            raise FaultScheduleError(
+                f"fault[{i}]: slice must be a non-negative integer, "
+                f"got {slice_!r}"
+            )
     else:
         known.add("chip")
         if "chip" not in rec:
@@ -158,7 +182,8 @@ def _parse_fault(i: int, rec: dict) -> Fault:
             f"fault[{i}]: unknown field(s) {sorted(extra)} for {kind}"
         )
     return Fault(
-        kind=kind, src=src, dst=dst, chip=chip, scale=float(scale),
+        kind=kind, src=src, dst=dst, chip=chip, slice=slice_,
+        scale=float(scale),
         start_cycle=float(start), end_cycle=float(end),
         directed=bool(rec.get("directed", False)),
     )
@@ -206,6 +231,8 @@ class FaultSchedule:
                 rec["dst"] = list(f.dst) if isinstance(f.dst, tuple) else f.dst
                 if f.directed:
                     rec["directed"] = True
+            elif f.kind in _DCN_KINDS:
+                rec["slice"] = f.slice
             else:
                 rec["chip"] = (
                     list(f.chip) if isinstance(f.chip, tuple) else f.chip
@@ -309,6 +336,11 @@ class FaultState:
                         f"{list(topo.coords(b))} (not torus neighbors)"
                     )
                 self._bound.append((f, (a, b)))
+            elif f.kind in _DCN_KINDS:
+                # slice indices bind as-is: the ICI topology does not
+                # know the slice count — range checks live in the dcn
+                # passes (TL232) against the configured fabric
+                self._bound.append((f, int(f.slice)))
             else:
                 c = _resolve_chip(topo, i, "chip", f.chip)
                 self._bound.append((f, c))
@@ -358,6 +390,7 @@ class FaultView:
     __slots__ = (
         "dead", "scales", "chip_clock", "chip_hbm", "broken_axes",
         "axis_min_scale", "num_active", "signature", "min_link_scale",
+        "dcn_nics_down", "dcn_scales", "slices_down",
     )
 
     @classmethod
@@ -375,6 +408,9 @@ class FaultView:
         link_factors: dict[tuple[int, int], list[float]] = {}
         clock_factors: dict[int, list[float]] = {}
         hbm_factors: dict[int, list[float]] = {}
+        nics_down: dict[int, int] = {}
+        dcn_factors: dict[int, list[float]] = {}
+        slices_down: set[int] = set()
         for f, where in bound:
             if f.kind == "link_down":
                 a, b = where
@@ -390,6 +426,12 @@ class FaultView:
                 clock_factors.setdefault(where, []).append(f.scale)
             elif f.kind == "hbm_throttle":
                 hbm_factors.setdefault(where, []).append(f.scale)
+            elif f.kind == "dcn_link_down":
+                nics_down[where] = nics_down.get(where, 0) + 1
+            elif f.kind == "dcn_link_degraded":
+                dcn_factors.setdefault(where, []).append(f.scale)
+            elif f.kind == "slice_down":
+                slices_down.add(where)
 
         def _reduce(factors: dict) -> dict:
             out = {}
@@ -407,12 +449,18 @@ class FaultView:
         self.scales = scales
         self.chip_clock = chip_clock
         self.chip_hbm = chip_hbm
+        self.dcn_nics_down = nics_down
+        self.dcn_scales = _reduce(dcn_factors)
+        self.slices_down = frozenset(slices_down)
         self.num_active = len(bound)
         self.signature = (
             self.dead,
             tuple(sorted(scales.items())),
             tuple(sorted(chip_clock.items())),
             tuple(sorted(chip_hbm.items())),
+            tuple(sorted(nics_down.items())),
+            tuple(sorted(self.dcn_scales.items())),
+            self.slices_down,
         )
         # per-axis degradation summary for the analytic schedules: an
         # axis with ANY dead link cannot run the counter-rotating ring
@@ -467,11 +515,19 @@ class FaultView:
     def stats_dict(self) -> dict[str, float]:
         """The ``faults_*`` stat keys a driver stamps when a schedule is
         active (never emitted on the healthy path — PR 1's no-op-default
-        discipline)."""
-        return {
+        discipline).  DCN keys ride along only when a DCN fault is
+        bound, so pre-fabric schedules keep their exact byte shape."""
+        out = {
             "faults_active": self.num_active,
             "faults_links_down": self.links_down,
             "faults_links_degraded": self.links_degraded,
             "faults_chips_degraded": self.chips_degraded,
             "faults_min_link_scale": self.min_link_scale,
         }
+        if self.dcn_nics_down or self.dcn_scales or self.slices_down:
+            out["faults_dcn_links_down"] = sum(
+                self.dcn_nics_down.values()
+            )
+            out["faults_dcn_links_degraded"] = len(self.dcn_scales)
+            out["faults_slices_down"] = len(self.slices_down)
+        return out

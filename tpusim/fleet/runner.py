@@ -49,7 +49,9 @@ from tpusim.campaign.journal import Journal
 # the campaign executor's pricing + partition primitives are reused
 # verbatim: the fleet twin must price a degraded window EXACTLY as a
 # campaign scenario would, or the two layers' answers drift apart
-from tpusim.campaign.runner import _disconnected, _pod_devices, _price
+from tpusim.campaign.runner import (
+    _dcn_lost_slices, _disconnected, _pod_devices, _price,
+)
 from tpusim.fleet.report import build_report
 from tpusim.fleet.spec import FleetSpec, Policies, load_fleet_spec, spec_hash
 from tpusim.fleet.traffic import sample_arrivals, sample_pod_stream
@@ -369,9 +371,29 @@ def simulate_cell(
 # ---------------------------------------------------------------------------
 
 
+def _state_partitions(
+    topo, view, replay_chips: int, dcn=None,
+) -> bool:
+    """Fleet window partition test: dead links disconnecting the
+    replaying chips, or — with a configured fabric — a whole
+    participating TPU slice lost (``slice_down`` / every DCN NIC dead).
+    The event walk attributes requests landing in such a window to the
+    ``partition`` loss bucket."""
+    if _disconnected(topo, view, replay_chips):
+        return True
+    if dcn is not None:
+        lost, _s = _dcn_lost_slices(
+            view, dcn, topo.num_chips, replay_chips,
+        )
+        if lost:
+            return True
+    return False
+
+
 def _price_state(
     sig: str, fault_docs: list[dict], pod, cfg, topo, cache, workers,
     healthy: dict | None, replay_chips: int, check_partition: bool,
+    dcn=None,
 ) -> dict:
     """Price one degradation state (or detect its partition).  The row
     is what the event walk consumes: step seconds + energy, or a
@@ -380,8 +402,8 @@ def _price_state(
 
     if fault_docs:
         sched = load_fault_schedule({"faults": fault_docs})
-        if check_partition and _disconnected(
-            topo, sched.bind(topo).view_at(0.0), replay_chips,
+        if check_partition and _state_partitions(
+            topo, sched.bind(topo).view_at(0.0), replay_chips, dcn,
         ):
             return {"partitioned": True, "step_s": None,
                     "energy_j": None, "inflation": None}
@@ -409,13 +431,15 @@ def _price_state(
 
 
 def _recovery_rows(
-    spec: FleetSpec, pod, cfg, cache, workers, deaths_by_pod,
-    completed: dict[int, dict], journal, cancel, stats: FleetStats,
-    progress,
+    spec: FleetSpec, pod, cfg, chips: int, cache, workers,
+    deaths_by_pod, completed: dict[int, dict], journal, cancel,
+    stats: FleetStats, progress,
 ) -> list[dict]:
     """Elastic-recovery pricing, one row per pod-loss event: re-rank
     the survivors with the advise transforms, price the re-shard
-    migration over DCN, report time-to-recover."""
+    migration over DCN — through the modeled fabric's per-slice
+    injection bandwidth when the spec configures one, else the flat
+    ``recovery.dcn_gbps`` constant — and report time-to-recover."""
     events = sorted(
         (d, p) for p, ds in enumerate(deaths_by_pod) for d, _end in ds
     )
@@ -427,6 +451,15 @@ def _recovery_rows(
     from tpusim.ici.topology import torus_for
     from tpusim.sim.driver import SimDriver
 
+    fabric = None
+    if spec.dcn is not None:
+        from tpusim.dcn import DcnFabric, slice_topology_for
+
+        st = slice_topology_for(chips, cfg.arch.ici)
+        if st is not None:
+            # migration prices over the HEALTHY fabric: the recovering
+            # pod is a fresh stand-in, not the degraded one
+            fabric = DcnFabric(st)
     profile = None
     rows: list[dict] = []
     for i, (at_s, pod_idx) in enumerate(events):
@@ -448,8 +481,13 @@ def _recovery_rows(
         )
         if profile is None:
             profile = build_profile(pod)
-        migration_s = profile.param_bytes_total \
-            / (spec.recovery.dcn_gbps * 1e9 / 8.0)
+        if fabric is not None:
+            migration_s = fabric.transfer_seconds(
+                profile.param_bytes_total, 0,
+            )
+        else:
+            migration_s = profile.param_bytes_total \
+                / (spec.recovery.dcn_gbps * 1e9 / 8.0)
         rerank: list[dict] = []
         if survivors >= 1:
             degrees = {}
@@ -618,8 +656,16 @@ def run_fleet(
         batch_stats = BatchStats()
     cache = as_result_cache(result_cache) or ResultCache()
     chips = spec.chips or default_chips
+    overlays = [{"power_enabled": True}]
+    if spec.dcn is not None:
+        # stand the modeled DCN fabric up over the pod shape: the
+        # collective model's hierarchical decomposition and the
+        # recovery migration both read the overlaid arch.ici.* fields
+        from tpusim.dcn.spec import fabric_overlay
+
+        overlays.append(fabric_overlay(spec.dcn, chips))
     cfg = load_config(
-        arch=spec.arch, overlays=[{"power_enabled": True}],
+        arch=spec.arch, overlays=overlays,
         tuned=spec.tuned,
     )
     topo = torus_for(chips, cfg.arch.name)
@@ -671,7 +717,7 @@ def run_fleet(
                 cancel.check()
             row = _price_state(
                 sig, docs, pod, cfg, topo, cache, workers, healthy,
-                replay_chips, check_partition,
+                replay_chips, check_partition, dcn=spec.dcn,
             )
             stats.states_priced += 1
             if row["partitioned"]:
@@ -719,8 +765,9 @@ def run_fleet(
                         st = load_fault_schedule(
                             {"faults": docs}
                         ).bind(topo)
-                        if check_partition and _disconnected(
+                        if check_partition and _state_partitions(
                             topo, st.view_at(0.0), replay_chips,
+                            spec.dcn,
                         ):
                             continue  # becomes a partitioned row
                         states.append(st)
@@ -775,7 +822,7 @@ def run_fleet(
 
         # -- elastic recovery (prices through the same shared cache)
         recovery = _recovery_rows(
-            spec, pod, cfg, cache, workers,
+            spec, pod, cfg, chips, cache, workers,
             deaths_by_pod[: spec.pods], recovery_done, journal, cancel,
             stats, progress,
         )

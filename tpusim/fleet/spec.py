@@ -44,6 +44,8 @@ Spec document::
         "restart_backoff_s": 5.0
       },
       "recovery": {"dcn_gbps": 25.0},
+      "dcn": {"num_slices": 2, "nics_per_slice": 4,
+              "nic_bandwidth": 25e9},
       "slo": {"latency_ms": 400.0, "percentile": 99},
       "frontier": {"target_rps": [40.0], "max_pods": 6}
     }
@@ -56,6 +58,14 @@ the :data:`tpusim.faults.FAULT_KINDS` table, but every sampled fault is
 WINDOWED in fleet seconds (``window.min_s``..``max_s`` long, anywhere in
 the horizon); ``pod_loss.prob`` is the per-pod probability of one
 whole-pod crash, healed after ``policies.restart_backoff_s``.
+
+The optional ``dcn`` block (:mod:`tpusim.dcn.spec`) stands a modeled
+multi-slice DCN fabric up over every pod: it is required before
+``faults.kinds`` may sample the DCN kinds
+(``dcn_link_down``/``dcn_link_degraded``/``slice_down``), and when
+present the recovery migration prices over the fabric's per-slice
+injection bandwidth instead of the flat ``recovery.dcn_gbps`` constant
+(kept as the back-compat path for fabric-less specs).
 
 ``policies`` maps 1:1 onto the serve daemon's flags — ``max_inflight``
 ↔ ``--max-inflight``, ``queue_depth`` ↔ ``--queue-depth``,
@@ -402,7 +412,12 @@ class Policies:
 
 @dataclass(frozen=True)
 class RecoveryModel:
-    """Elastic-recovery pricing knobs (pod-loss re-shard migration)."""
+    """Elastic-recovery pricing knobs (pod-loss re-shard migration).
+
+    ``dcn_gbps`` is the flat-constant back-compat path: it prices the
+    migration only when the spec has no ``dcn`` block; with a modeled
+    fabric the migration goes through
+    :meth:`tpusim.dcn.DcnFabric.transfer_seconds` instead."""
 
     dcn_gbps: float = 25.0
 
@@ -510,6 +525,9 @@ class FleetSpec:
     recovery: RecoveryModel
     slo: LatencySlo | None
     frontier: FrontierSpec | None
+    #: the modeled multi-slice DCN fabric (None = single slice / flat
+    #: constant recovery) — a :class:`tpusim.dcn.DcnBlock`
+    dcn: object | None = None
     #: the raw document, canonicalized — :func:`spec_hash` and the
     #: journal header are computed from it
     doc: dict = field(repr=False, hash=False, compare=False,
@@ -527,7 +545,7 @@ class FleetSpec:
 _TOP_FIELDS = {
     "name", "seed", "pods", "arch", "chips", "tuned", "horizon_s",
     "traffic", "faults", "correlated_groups", "policies", "recovery",
-    "slo", "frontier",
+    "slo", "frontier", "dcn",
 }
 
 
@@ -611,6 +629,24 @@ def load_fleet_spec(src) -> FleetSpec:
              "correlated_groups: duplicate group names")
     policies = Policies.parse(doc.get("policies"))
     recovery = RecoveryModel.parse(doc.get("recovery"))
+    dcn = None
+    if doc.get("dcn") is not None:
+        from tpusim.dcn.spec import DcnBlock, DcnSpecError
+
+        try:
+            dcn = DcnBlock.parse(doc["dcn"])
+        except DcnSpecError as e:
+            raise FleetSpecError(str(e), code="TL230") from e
+    from tpusim.faults.schedule import _DCN_KINDS
+
+    dcn_kinds = [k for k, _w in faults.kinds if k in _DCN_KINDS]
+    _require(
+        not dcn_kinds or dcn is not None,
+        f"faults.kinds samples DCN fault kind(s) {dcn_kinds} but the "
+        f"spec has no 'dcn' block — a DCN fault needs a configured "
+        f"fabric to degrade",
+        code="TL231",
+    )
     slo = LatencySlo.parse(doc["slo"]) if doc.get("slo") is not None \
         else None
     frontier = None
@@ -627,7 +663,8 @@ def load_fleet_spec(src) -> FleetSpec:
         name=name, seed=seed, pods=pods, arch=arch, chips=chips,
         tuned=tuned, horizon_s=horizon_s, traffic=traffic,
         faults=faults, groups=groups, policies=policies,
-        recovery=recovery, slo=slo, frontier=frontier, doc=doc,
+        recovery=recovery, slo=slo, frontier=frontier, dcn=dcn,
+        doc=doc,
     )
 
 

@@ -30,7 +30,7 @@ import random
 
 from tpusim.campaign.sample import _weighted_kind
 from tpusim.campaign.spec import CorrelatedGroup
-from tpusim.faults.schedule import FAULT_KINDS, _LINK_KINDS
+from tpusim.faults.schedule import FAULT_KINDS, _DCN_KINDS, _LINK_KINDS
 from tpusim.fleet.spec import FleetSpec, TrafficModel
 
 __all__ = [
@@ -148,10 +148,18 @@ def sample_pod_stream(spec: FleetSpec, topo, pod_index: int) -> dict:
             recs.extend(_group_records(g, topo, _sample_window(rng, spec)))
 
     links = topo.undirected_links()
+    num_slices = spec.dcn.num_slices if spec.dcn is not None else 0
     n = fm.count.sample(rng)
     for _ in range(n):
         kind = _weighted_kind(rng, fm.kinds)
-        if kind in _LINK_KINDS:
+        if kind in _DCN_KINDS:
+            # DCN faults target a TPU hardware slice of the configured
+            # fabric (spec validation guarantees a dcn block exists
+            # when these kinds have weight — TL231)
+            if num_slices <= 1:
+                continue
+            rec = {"kind": kind, "slice": rng.randrange(num_slices)}
+        elif kind in _LINK_KINDS:
             if not links:
                 # a 1-chip slice has no ICI links: the draw is omitted
                 # (the zero-fault stream is already a legitimate

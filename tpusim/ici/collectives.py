@@ -24,12 +24,22 @@ the group = 2 per torus axis):
 The per-collective time is ``launch_latency + max(bandwidth term, latency
 term)`` with the cheaper of ring/tree chosen, mirroring how real collective
 libraries switch algorithms by message size.
+
+Multi-slice groups (``0 < chips_per_slice < N``) add a DCN term.  Two
+models coexist: the original flat scalar (ring over S slices at
+``dcn_bandwidth``, applied as a max) and — when a fabric is configured
+via ``dcn_nics_per_slice`` (:mod:`tpusim.dcn`) — a hierarchical
+decomposition (in-slice reduce-scatter → cross-slice all-reduce over
+the modeled fabric → in-slice all-gather, per-kind variants in
+``_hier_seconds``), with the cheaper of flat/hierarchical chosen the
+same way ring/tree is.  An unconfigured fabric prices byte-identically
+to the flat model.
 """
 
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
 from tpusim.ir import CollectiveInfo
@@ -45,6 +55,11 @@ __all__ = ["CollectiveModel", "collective_seconds"]
 class CollectiveModel:
     topo: Topology
     cfg: "IciConfig"
+    # memoized inter-slice fabric (tpusim.dcn); False = not yet built,
+    # None = fabric unconfigured (the flat scalar model stays in charge)
+    _fabric: object = field(
+        default=False, init=False, repr=False, compare=False
+    )
 
     # -- helpers -----------------------------------------------------------
 
@@ -113,6 +128,68 @@ class CollectiveModel:
             + self.cfg.dcn_latency * math.ceil(math.log2(max(s, 2)))
         )
 
+    def _dcn_fabric(self):
+        """The modeled inter-slice fabric (:mod:`tpusim.dcn`), bound to
+        this model's fault view; None when unconfigured — every path
+        below then degenerates byte-identically to the flat scalar
+        ``_dcn_term`` model."""
+        if self._fabric is False:
+            from tpusim.dcn.fabric import DcnFabric
+            from tpusim.dcn.topology import slice_topology_for
+
+            st = slice_topology_for(self.topo.num_chips, self.cfg)
+            self._fabric = (
+                DcnFabric(st, self.topo.faults)
+                if st is not None else None
+            )
+        return self._fabric
+
+    def _hier_seconds(
+        self, kind: str, payload: float, n: int
+    ) -> float | None:
+        """Hierarchical decomposition of a slice-spanning collective
+        over the modeled fabric: in-slice phases priced by the ICI
+        schedules above, the cross-slice phase by the fabric.  Each
+        phase is a separately launched collective (it pays its own
+        ``launch_latency``).  None when the fabric is unconfigured; may
+        be ``inf`` when a participating slice has zero DCN bandwidth —
+        the caller's ``min(flat, hier)`` then keeps the flat cap, and
+        slice-loss catastrophe is attributed by the campaign/fleet
+        executors, not the cost model."""
+        fabric = self._dcn_fabric()
+        if fabric is None:
+            return None
+        m = min(self.cfg.chips_per_slice, n)
+        s = math.ceil(n / m)
+        launch = self.cfg.launch_latency
+        if kind == "all-reduce":
+            # in-slice reduce-scatter -> cross-slice all-reduce of the
+            # full payload (each slice's m shards inject concurrently)
+            # -> in-slice all-gather
+            return (
+                self.reducescatter_seconds(payload, m)
+                + launch + fabric.cross_allreduce_seconds(payload, s)
+                + self.allgather_seconds(payload, m)
+            )
+        if kind == "all-gather":
+            # cross-slice all-gather of the full result between slice
+            # representatives, then in-slice all-gather fans it out
+            # (reduce-scatter is the same walk mirrored — its caller
+            # delegates here via allgather_seconds)
+            return (
+                launch + fabric.cross_allgather_seconds(payload, s)
+                + self.allgather_seconds(payload, m)
+            )
+        if kind == "all-to-all":
+            # in-slice exchange, then each slice pushes its (S-1)/S
+            # off-slice fraction through its NIC bank
+            return (
+                self.alltoall_seconds(payload, m)
+                + launch
+                + fabric.cross_alltoall_seconds(payload, m, s)
+            )
+        return None
+
     # -- schedules ---------------------------------------------------------
 
     def allreduce_seconds(self, payload: float, n: int) -> float:
@@ -126,6 +203,9 @@ class CollectiveModel:
         t = min(ring_bw + ring_lat, tree_bw + tree_lat)
         if self._spans_dcn(n):
             t = max(t, self._dcn_term(payload, n))
+            hier = self._hier_seconds("all-reduce", payload, n)
+            if hier is not None:
+                return min(self.cfg.launch_latency + t, hier)
         return self.cfg.launch_latency + t
 
     def allgather_seconds(self, full_bytes: float, n: int) -> float:
@@ -136,6 +216,9 @@ class CollectiveModel:
         t = (n - 1) / n * full_bytes / w + (n - 1) * self.cfg.hop_latency
         if self._spans_dcn(n):
             t = max(t, 0.5 * self._dcn_term(full_bytes, n))
+            hier = self._hier_seconds("all-gather", full_bytes, n)
+            if hier is not None:
+                return min(self.cfg.launch_latency + t, hier)
         return self.cfg.launch_latency + t
 
     def reducescatter_seconds(self, in_bytes: float, n: int) -> float:
@@ -172,6 +255,9 @@ class CollectiveModel:
             remaining = max(remaining // n_ax, 1)
         if self._spans_dcn(n):
             t = max(t, self._dcn_term(payload, n))
+            hier = self._hier_seconds("all-to-all", payload, n)
+            if hier is not None:
+                return min(self.cfg.launch_latency + t, hier)
         return self.cfg.launch_latency + t
 
     def permute_seconds(
@@ -193,6 +279,28 @@ class CollectiveModel:
             if self.topo.num_chips > max(s, t_):
                 max_hops = max(max_hops, self.topo.hop_distance(s, t_))
         fan = max(out_degree.values())
+        fabric = self._dcn_fabric()
+        if fabric is not None:
+            # cross-slice shifts pay the DCN hop: the slice with the
+            # most crossing pairs bottlenecks at its own NIC bank
+            # (fabric-gated — unconfigured fabrics change nothing)
+            crossing: dict[int, int] = {}
+            for s, t_ in pairs:
+                src = fabric.slices.slice_of(s)
+                if src != fabric.slices.slice_of(t_):
+                    crossing[src] = crossing.get(src, 0) + 1
+            cross = 0.0
+            for src, cnt in crossing.items():
+                w_s = fabric.slice_bandwidth(src)
+                cross = max(cross, (
+                    cnt * payload / w_s if w_s > 0.0 else math.inf
+                ) + fabric.slices.hop_latency)
+            if cross > 0.0:
+                return self.cfg.launch_latency + max(
+                    fan * payload / w
+                    + max_hops * self.cfg.hop_latency,
+                    cross,
+                )
         return (
             self.cfg.launch_latency
             + fan * payload / w

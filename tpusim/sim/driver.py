@@ -707,6 +707,20 @@ class SimDriver:
             )
             if worst_occ is not None:
                 report.stats.set("faults_worst_link_occupancy", worst_occ)
+        from tpusim.dcn import slice_topology_for
+
+        slice_topo = slice_topology_for(base_topo.num_chips, cfg.arch.ici)
+        if slice_topo is not None and slice_topo.num_slices > 1:
+            # dcn_* keys ride the report ONLY when a DCN fabric is
+            # configured AND this pod actually spans slices (the
+            # faults_* discipline: single-slice and fabric-less runs
+            # stay key-identical, goldens pinned)
+            report.stats.update({
+                "dcn_slices": slice_topo.num_slices,
+                "dcn_chips_per_slice": slice_topo.chips_per_slice,
+                "dcn_nics_per_slice": slice_topo.nics_per_slice,
+                "dcn_slice_bandwidth": slice_topo.slice_bandwidth(),
+            })
         if cfg.power_enabled:
             from tpusim.power.model import PowerModel
 

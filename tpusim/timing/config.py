@@ -53,6 +53,17 @@ class IciConfig:
     dcn_bandwidth: float = 25e9
     dcn_latency: float = 10e-6
     chips_per_slice: int = 0            # 0 = single slice
+    # modeled DCN fabric (tpusim.dcn): per-slice NIC count gates the
+    # whole fabric — 0 leaves the flat dcn_bandwidth/dcn_latency scalar
+    # model in charge (byte-identical to the pre-fabric pricing)
+    dcn_nics_per_slice: int = 0
+    # per-NIC-hop bandwidth (bytes/s) and latency (s); 0 falls back to
+    # dcn_bandwidth / dcn_latency so a fabric can be enabled by NIC
+    # count alone
+    dcn_hop_bandwidth: float = 0.0
+    dcn_hop_latency: float = 0.0
+    # spine oversubscription factor (>= 1 divides usable bandwidth)
+    dcn_oversubscription: float = 1.0
     # network implementation (the -network_mode equivalent):
     # "analytic" = closed-form schedule math (collectives.py);
     # "detailed" = per-packet link contention sim (detailed.py / ici_net.cpp)
@@ -327,6 +338,10 @@ CONFIG_FIELD_RULES: dict[str, str] = {
     "arch.ici.dcn_bandwidth": "positive",
     "arch.ici.dcn_latency": "nonneg",
     "arch.ici.chips_per_slice": "nonneg",
+    "arch.ici.dcn_nics_per_slice": "nonneg",
+    "arch.ici.dcn_hop_bandwidth": "nonneg",
+    "arch.ici.dcn_hop_latency": "nonneg",
+    "arch.ici.dcn_oversubscription": "positive",
     "arch.ici.network_mode": "enum:analytic,detailed",
     "arch.ici.packet_bytes": "positive",
     # --- SimConfig --------------------------------------------------------
